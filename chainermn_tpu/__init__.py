@@ -1,0 +1,26 @@
+"""chainermn_tpu — a TPU-native distributed-training framework.
+
+Capability parity with ChainerMN (reference: ``okuta/chainermn``; see
+SURVEY.md) built idiomatically on JAX/XLA: communicators lower to XLA
+collectives over ICI/DCN instead of NCCL/MPI, gradient averaging fuses into
+one jitted SPMD step instead of eager bucketed allreduce, and model
+parallelism is sharding + ppermute instead of MPI send/recv.  No CUDA, NCCL
+or mpi4py anywhere in the import graph.
+"""
+
+from . import ops  # noqa: F401
+from .communicators import (  # noqa: F401
+    CommunicatorBase,
+    NaiveCommunicator,
+    XlaCommunicator,
+    create_communicator,
+)
+from .topology import (  # noqa: F401
+    DEFAULT_AXIS_NAME,
+    Topology,
+    init_distributed,
+    make_mesh,
+    make_nd_mesh,
+)
+
+__version__ = "0.1.0"

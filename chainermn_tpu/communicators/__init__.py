@@ -1,0 +1,65 @@
+"""Communicator factory.
+
+Reference parity: ``chainermn/communicators/__init__.py ::
+create_communicator(communicator_name='hierarchical', ...)`` [uv]
+(SURVEY.md §2.1).  The reference dispatches over seven NCCL/MPI topology
+variants (``pure_nccl``, ``hierarchical``, ``two_dimensional``, ``flat``,
+``naive``, ``single_node``, ``non_cuda_aware``) because GPU clusters expose
+a two-tier fabric (NVLink intra-node, IB/Ethernet inter-node) that software
+must orchestrate.  A TPU slice has ONE fabric (ICI) orchestrated by XLA, so
+every accelerated variant maps to the same backend; the historical names are
+accepted as aliases so reference users' ``--communicator`` flags keep
+working, each alias documented with what it used to mean.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .base import CommunicatorBase
+from .naive import NaiveCommunicator
+from .xla import XlaCommunicator
+
+# name → (class, note) — aliases preserve the reference's CLI surface.
+_ALIASES = {
+    "xla": "the TPU-native backend (ICI collectives via XLA)",
+    "pure_nccl": "reference's NCCL-everywhere path → XLA/ICI",
+    "hierarchical": "reference's NCCL-intra + MPI-inter → XLA/ICI (single fabric)",
+    "two_dimensional": "reference's 2-D reduce-scatter/allgather → XLA/ICI",
+    "flat": "reference's flat CUDA-aware-MPI path → XLA/ICI",
+    "single_node": "reference's single-node NCCL path → XLA/ICI",
+    "non_cuda_aware": "reference's host-staged path → XLA/ICI (no host staging on TPU)",
+}
+
+
+def create_communicator(
+    communicator_name: str = "xla",
+    mesh=None,
+    devices=None,
+    size: Optional[int] = None,
+    axis_name: Optional[str] = None,
+) -> CommunicatorBase:
+    """Create a communicator by name (reference: ``create_communicator`` [uv]).
+
+    ``naive`` gives the pure-host numpy loopback (debug/oracle); every other
+    historical name resolves to :class:`XlaCommunicator`.
+    """
+    name = communicator_name.lower()
+    if name == "naive":
+        return NaiveCommunicator(size=size)
+    if name in _ALIASES:
+        kwargs = {}
+        if axis_name is not None:
+            kwargs["axis_name"] = axis_name
+        return XlaCommunicator(mesh=mesh, devices=devices, **kwargs)
+    raise ValueError(
+        f"unknown communicator {communicator_name!r}; known: "
+        f"{['naive', *sorted(_ALIASES)]}")
+
+
+__all__ = [
+    "CommunicatorBase",
+    "NaiveCommunicator",
+    "XlaCommunicator",
+    "create_communicator",
+]

@@ -1,0 +1,190 @@
+"""Abstract communicator interface.
+
+Reference parity: ``chainermn/communicators/communicator_base.py ::
+CommunicatorBase`` [uv] (SURVEY.md §2.1) — properties ``rank, size,
+intra_rank, intra_size, inter_rank, inter_size``; collectives ``send, recv,
+bcast, gather, allgather, alltoall, scatter, allreduce``; object variants
+``send_obj, recv_obj, bcast_obj, gather_obj, allreduce_obj``; model helpers
+``broadcast_data`` and ``multi_node_mean_grad`` (older name
+``allreduce_grad``); ``split`` and ``finalize``.
+
+Eager data model — **rank-major arrays** instead of per-process arrays
+------------------------------------------------------------------------
+ChainerMN is multi-process SPMD: every rank calls ``comm.allreduce(x)`` with
+its own ``x`` and receives its own result.  JAX on TPU is single-controller
+per host with *global* arrays, so the eager parity face here operates on
+**rank-major stacked arrays**: an input of logical per-rank shape ``s`` is
+passed as one global array of shape ``(size, *s)`` whose slab ``[r]`` is rank
+``r``'s value, sharded over the communicator mesh so slab ``r`` physically
+lives on chip ``r``.  Every collective returns the rank-major stack of what
+each rank would have received:
+
+    ``allreduce``: out[r] = reduce(x[0..size-1])          (same for all r)
+    ``bcast``:     out[r] = x[root]
+    ``gather``:    out    = x  (the full stack; meaningful at root)
+    ``allgather``: out[r] = x  (i.e. out has shape (size, size, *s))
+    ``alltoall``:  out[r][s] = x[s][r]  (transpose of the two rank axes)
+    ``scatter``:   out[r] = x_root[r]   (root's (size, *s) array split up)
+    ``send/recv``: ppermute-style shifts of slabs between ranks
+
+Why this shape: it keeps the whole test matrix runnable in ONE process over N
+devices (real chips or ``--xla_force_host_platform_device_count``), exactly
+mirroring how the reference fakes multi-node with single-node MPI
+(SURVEY.md §4), while the *in-jit* face (``chainermn_tpu.ops``) is what the
+hot path uses inside a single compiled SPMD program.
+
+This eager face is for tests, setup, and debugging; training steps should go
+through ``create_multi_node_optimizer`` which fuses the mean-gradient
+collective into the jitted step (SURVEY.md §3.2 "TPU mapping").
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+
+class CommunicatorBase:
+    """API contract shared by every communicator backend."""
+
+    # ---- topology properties (reference: communicator_base.py [uv]) ----
+    @property
+    def rank(self) -> int:
+        """This *process*'s first rank (host-level under multi-controller)."""
+        raise NotImplementedError
+
+    @property
+    def size(self) -> int:
+        raise NotImplementedError
+
+    @property
+    def intra_rank(self) -> int:
+        raise NotImplementedError
+
+    @property
+    def intra_size(self) -> int:
+        raise NotImplementedError
+
+    @property
+    def inter_rank(self) -> int:
+        raise NotImplementedError
+
+    @property
+    def inter_size(self) -> int:
+        raise NotImplementedError
+
+    # ---- array collectives over rank-major stacks ----
+    def allreduce(self, x, op: str = "sum"):
+        raise NotImplementedError
+
+    def bcast(self, x, root: int = 0):
+        raise NotImplementedError
+
+    def gather(self, x, root: int = 0):
+        raise NotImplementedError
+
+    def allgather(self, x):
+        raise NotImplementedError
+
+    def alltoall(self, x):
+        raise NotImplementedError
+
+    def scatter(self, x, root: int = 0):
+        raise NotImplementedError
+
+    def send(self, x, dest: int, source: int):
+        """Move rank ``source``'s slab to rank ``dest`` (one-shot p2p)."""
+        raise NotImplementedError
+
+    def recv(self, x, source: int, dest: int):
+        raise NotImplementedError
+
+    # ---- object (pickle) transport — setup path only, never hot ----
+    def bcast_obj(self, obj: Any, root: int = 0) -> Any:
+        raise NotImplementedError
+
+    def gather_obj(self, obj: Any, root: int = 0) -> Optional[List[Any]]:
+        raise NotImplementedError
+
+    def allgather_obj(self, obj: Any) -> List[Any]:
+        raise NotImplementedError
+
+    def allreduce_obj(self, obj: Any, op: Callable = None) -> Any:
+        raise NotImplementedError
+
+    def send_obj(self, obj: Any, dest: int) -> None:
+        raise NotImplementedError
+
+    def recv_obj(self, source: int) -> Any:
+        raise NotImplementedError
+
+    # ---- model helpers ----
+    def broadcast_data(self, params):
+        """Replicate a parameter pytree to every chip (reference:
+        ``CommunicatorBase.broadcast_data(model)`` [uv] — MPI bcast of every
+        param from rank 0).  TPU-native: device_put with a fully-replicated
+        sharding over the communicator mesh; XLA broadcasts over ICI."""
+        raise NotImplementedError
+
+    def multi_node_mean_grad(self, grads):
+        """Mean a rank-major stacked gradient pytree across ranks (reference:
+        ``multi_node_mean_grad`` / older ``allreduce_grad`` [uv])."""
+        raise NotImplementedError
+
+    # Backwards-compatible alias, as in the reference.
+    def allreduce_grad(self, grads):
+        return self.multi_node_mean_grad(grads)
+
+    # ---- structure ----
+    def split(self, color, key: int = 0):
+        """Partition ranks into sub-communicators (reference:
+        ``mpi_comm.Split(color, key)`` [uv]).
+
+        In MPI every rank passes *its own* scalar color; a single-controller
+        process owns all ranks at once, so the color argument is either
+
+        * a sequence of per-rank colors → returns ``{color: communicator}``
+          over the matching device subsets (all groups at once), or
+        * a scalar → every rank has the same color, which in MPI semantics
+          yields one group containing the whole world → returns a single
+          communicator over all devices.
+
+        ``key`` (MPI's rank-reordering knob) is accepted for parity but
+        ignored: device order inside a group follows global rank order.
+        """
+        raise NotImplementedError
+
+    def finalize(self) -> None:
+        pass
+
+    # ---- conveniences shared by all backends ----
+    def stack(self, per_rank: Sequence[Any]):
+        """Build a rank-major stacked array from a list of per-rank arrays."""
+        if len(per_rank) != self.size:
+            raise ValueError(f"need {self.size} per-rank arrays, got {len(per_rank)}")
+        return self._place(np.stack([np.asarray(a) for a in per_rank]))
+
+    def unstack(self, x) -> List[np.ndarray]:
+        """Split a rank-major stacked array back into per-rank numpy arrays."""
+        x = np.asarray(jax.device_get(x))
+        return [x[r] for r in range(x.shape[0])]
+
+    def _place(self, x):
+        """Backend hook: put a host array into the backend's native layout."""
+        return x
+
+    def _check_leading(self, x):
+        """Validate the rank-major contract: leading dim == size."""
+        if x.shape[0] != self.size:
+            raise ValueError(
+                f"rank-major stack must have leading dim {self.size}, got {x.shape}")
+        return x
+
+    def _check_alltoall(self, x):
+        self._check_leading(x)
+        if x.ndim < 2 or x.shape[1] != self.size:
+            raise ValueError(
+                f"alltoall needs shape (size, size, ...), got {x.shape}")
+        return x

@@ -1,0 +1,133 @@
+"""Pure-host reference communicator.
+
+Reference parity: ``chainermn/communicators/naive_communicator.py ::
+NaiveCommunicator`` [uv] (SURVEY.md §2.1) — the no-GPU, per-param, CPU-staged
+MPI baseline used for debugging and as the correctness floor (BASELINE
+config #1).  Here it is a pure-numpy implementation over rank-major stacks:
+no devices, no XLA, no mesh — which makes it the *oracle* every accelerated
+backend is tested against (the reference tested against numpy results the
+same way, SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Any, Callable, List, Optional
+
+import jax
+import numpy as np
+
+from .base import CommunicatorBase
+
+_REDUCERS = {
+    "sum": lambda x: x.sum(axis=0),
+    "mean": lambda x: x.mean(axis=0),
+    "max": lambda x: x.max(axis=0),
+    "min": lambda x: x.min(axis=0),
+    "prod": lambda x: x.prod(axis=0),
+}
+
+
+class NaiveCommunicator(CommunicatorBase):
+    """Loopback communicator: ``size`` logical ranks in one process, numpy math."""
+
+    def __init__(self, size: Optional[int] = None):
+        self._size = int(size) if size else max(len(jax.devices()), 1)
+        self._mailbox: List[bytes] = []  # FIFO for send_obj/recv_obj loopback
+
+    # topology: all ranks are "intra" (single host)
+    @property
+    def rank(self) -> int:
+        return 0
+
+    @property
+    def size(self) -> int:
+        return self._size
+
+    @property
+    def intra_rank(self) -> int:
+        return 0
+
+    @property
+    def intra_size(self) -> int:
+        return self._size
+
+    @property
+    def inter_rank(self) -> int:
+        return 0
+
+    @property
+    def inter_size(self) -> int:
+        return 1
+
+    # ---- array collectives ----
+    def _check(self, x) -> np.ndarray:
+        return self._check_leading(np.asarray(x))
+
+    def allreduce(self, x, op: str = "sum"):
+        x = self._check(x)
+        red = _REDUCERS[op](x)
+        return np.broadcast_to(red, x.shape).copy()
+
+    def bcast(self, x, root: int = 0):
+        x = self._check(x)
+        return np.broadcast_to(x[root], x.shape).copy()
+
+    def gather(self, x, root: int = 0):
+        return self._check(x).copy()
+
+    def allgather(self, x):
+        x = self._check(x)
+        return np.broadcast_to(x[None], (self._size,) + x.shape).copy()
+
+    def alltoall(self, x):
+        x = self._check_alltoall(self._check(x))
+        return np.swapaxes(x, 0, 1).copy()
+
+    def scatter(self, x, root: int = 0):
+        # Root's (size, *s) payload; each rank receives its slab — which for a
+        # rank-major stack is the identity layout.
+        return self._check(x).copy()
+
+    def send(self, x, dest: int, source: int):
+        x = self._check(x).copy()
+        x[dest] = x[source]
+        return x
+
+    def recv(self, x, source: int, dest: int):
+        return self.send(x, dest=dest, source=source)
+
+    # ---- object transport ----
+    def bcast_obj(self, obj: Any, root: int = 0) -> Any:
+        return pickle.loads(pickle.dumps(obj))
+
+    def gather_obj(self, obj: Any, root: int = 0) -> Optional[List[Any]]:
+        return [pickle.loads(pickle.dumps(obj)) for _ in range(self._size)]
+
+    def allgather_obj(self, obj: Any) -> List[Any]:
+        return self.gather_obj(obj)
+
+    def allreduce_obj(self, obj: Any, op: Callable = None) -> Any:
+        op = op or (lambda a, b: a + b)
+        out = obj
+        for _ in range(self._size - 1):
+            out = op(out, obj)
+        return out
+
+    def send_obj(self, obj: Any, dest: int) -> None:
+        self._mailbox.append(pickle.dumps(obj))
+
+    def recv_obj(self, source: int) -> Any:
+        return pickle.loads(self._mailbox.pop(0))
+
+    # ---- model helpers ----
+    def broadcast_data(self, params):
+        return jax.tree_util.tree_map(np.asarray, params)
+
+    def multi_node_mean_grad(self, grads):
+        return jax.tree_util.tree_map(lambda g: self.allreduce(g, op="mean"), grads)
+
+    def split(self, color: int, key: int) -> "NaiveCommunicator":
+        # Loopback has no real rank identity; splitting yields a fresh
+        # loopback of unknown membership — callers pass an explicit size.
+        return NaiveCommunicator(size=1)

@@ -1,0 +1,110 @@
+"""In-jit collective face — what the hot path uses.
+
+The reference's hot loop calls eager NCCL collectives between autograd and
+the optimizer (SURVEY.md §3.2).  TPU-native, the entire training step is ONE
+compiled SPMD program, and collectives are `jax.lax` ops *inside* it that
+XLA lowers onto ICI and schedules/overlaps itself — this module is the thin,
+named wrapper layer so framework code and user code share one vocabulary
+with the eager face (`communicators/`).
+
+All functions take `axis_name` (default ``"mn"``) and must be called inside
+a `shard_map`/`pmap` context where that axis is bound.  `pmean_if_bound`
+(the gradient-sync primitive) degrades to identity when the axis is not
+bound, which lets the same optimizer wrapper run unmodified under
+(a) shard_map SPMD, (b) plain pjit (where XLA inserts gradient reductions
+automatically from shardings), and (c) single-device tests.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..topology import DEFAULT_AXIS_NAME
+
+
+def _axis_bound(axis_name: str) -> bool:
+    """True when `axis_name` is a bound SPMD axis in the current trace."""
+    try:
+        jax.lax.axis_index(axis_name)
+        return True
+    except NameError:
+        return False
+    except Exception:
+        # jax raises NameError for unbound axes today; be defensive about the
+        # exact exception type across versions.
+        return False
+
+
+def psum(x, axis_name: str = DEFAULT_AXIS_NAME):
+    return jax.tree_util.tree_map(lambda v: jax.lax.psum(v, axis_name), x)
+
+
+def pmean(x, axis_name: str = DEFAULT_AXIS_NAME):
+    return jax.tree_util.tree_map(lambda v: jax.lax.pmean(v, axis_name), x)
+
+
+def pmax(x, axis_name: str = DEFAULT_AXIS_NAME):
+    return jax.tree_util.tree_map(lambda v: jax.lax.pmax(v, axis_name), x)
+
+
+def pmin(x, axis_name: str = DEFAULT_AXIS_NAME):
+    return jax.tree_util.tree_map(lambda v: jax.lax.pmin(v, axis_name), x)
+
+
+def pmean_if_bound(x, axis_name: Optional[str] = DEFAULT_AXIS_NAME):
+    """Mean across the axis if it is bound; identity otherwise.
+
+    This is the gradient-sync primitive of `create_multi_node_optimizer`:
+    under shard_map it is a real ICI all-reduce; under pjit-with-shardings
+    the axis is unbound and XLA's sharding propagation already produced
+    globally-correct mean gradients, so identity is exactly right.
+    """
+    if axis_name is None or not _axis_bound(axis_name):
+        return x
+    return pmean(x, axis_name)
+
+
+def all_gather(x, axis_name: str = DEFAULT_AXIS_NAME, axis: int = 0, tiled: bool = True):
+    return jax.lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def all_to_all(x, axis_name: str = DEFAULT_AXIS_NAME, split_axis: int = 0,
+               concat_axis: int = 0, tiled: bool = True):
+    return jax.lax.all_to_all(x, axis_name, split_axis=split_axis,
+                              concat_axis=concat_axis, tiled=tiled)
+
+
+def reduce_scatter(x, axis_name: str = DEFAULT_AXIS_NAME, scatter_axis: int = 0):
+    return jax.lax.psum_scatter(x, axis_name, scatter_dimension=scatter_axis,
+                                tiled=True)
+
+
+def ppermute(x, perm, axis_name: str = DEFAULT_AXIS_NAME):
+    return jax.lax.ppermute(x, axis_name, perm=perm)
+
+
+def shift(x, offset: int, axis_name: str = DEFAULT_AXIS_NAME, size: Optional[int] = None):
+    """Ring shift by `offset` (the ring-attention / pipeline building block)."""
+    if size is None:
+        size = jax.lax.axis_size(axis_name)
+    perm = [(i, (i + offset) % size) for i in range(size)]
+    return jax.lax.ppermute(x, axis_name, perm=perm)
+
+
+def axis_index(axis_name: str = DEFAULT_AXIS_NAME):
+    return jax.lax.axis_index(axis_name)
+
+
+def axis_size(axis_name: str = DEFAULT_AXIS_NAME) -> int:
+    return jax.lax.axis_size(axis_name)
+
+
+def bcast(x, root: int = 0, axis_name: str = DEFAULT_AXIS_NAME):
+    """Every rank gets rank `root`'s block (in-jit broadcast)."""
+    def one(v):
+        g = jax.lax.all_gather(v, axis_name, axis=0, tiled=False)
+        return g[root]
+    return jax.tree_util.tree_map(one, x)

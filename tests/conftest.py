@@ -1,0 +1,27 @@
+"""Test bootstrap: fake an 8-chip TPU slice with 8 CPU devices.
+
+Reference parity: ChainerMN tested multi-node behavior with multi-process
+single-node MPI (``mpiexec -n 8 pytest``, SURVEY.md §4).  We do one better —
+single-process, 8 virtual devices — so the whole matrix runs anywhere.
+MUST run before jax initializes its backend, hence module-level in conftest.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual CPU devices, got {devs}"
+    return devs

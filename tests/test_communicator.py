@@ -1,0 +1,167 @@
+"""Communicator test matrix.
+
+Reference parity: ``tests/communicator_tests/test_communicator.py`` [uv]
+(SURVEY.md §4) — every collective, parameterized over all communicator
+classes × dtypes, checked against numpy reference results; plus ``split``.
+The NaiveCommunicator doubles as the oracle for the XLA backend.
+"""
+
+import numpy as np
+import pytest
+
+import chainermn_tpu as mn
+
+COMMS = ["naive", "xla", "pure_nccl", "hierarchical", "flat"]
+DTYPES = [np.float32, np.float16, np.int32]
+SIZE = 8
+
+
+@pytest.fixture(params=COMMS, scope="module")
+def comm(request):
+    return mn.create_communicator(request.param, size=SIZE)
+
+
+def rank_major(shape, dtype, seed=0):
+    rng = np.random.RandomState(seed)
+    if np.issubdtype(dtype, np.integer):
+        return rng.randint(0, 10, size=(SIZE,) + shape).astype(dtype)
+    return rng.randn(SIZE, *shape).astype(dtype)
+
+
+@pytest.mark.parametrize("dtype", DTYPES)
+@pytest.mark.parametrize("op", ["sum", "max", "min"])
+def test_allreduce(comm, dtype, op):
+    x = rank_major((3, 5), dtype)
+    out = np.asarray(comm.allreduce(x, op=op))
+    want = {"sum": x.sum(0), "max": x.max(0), "min": x.min(0)}[op]
+    for r in range(SIZE):
+        np.testing.assert_allclose(out[r], want, rtol=2e-3)
+
+
+def test_allreduce_mean(comm):
+    x = rank_major((4,), np.float32)
+    out = np.asarray(comm.allreduce(x, op="mean"))
+    for r in range(SIZE):
+        np.testing.assert_allclose(out[r], x.mean(0), rtol=1e-5)
+
+
+@pytest.mark.parametrize("root", [0, 3, 7])
+def test_bcast(comm, root):
+    x = rank_major((2, 3), np.float32)
+    out = np.asarray(comm.bcast(x, root=root))
+    for r in range(SIZE):
+        np.testing.assert_array_equal(out[r], x[root])
+
+
+def test_gather(comm):
+    x = rank_major((5,), np.float32)
+    out = np.asarray(comm.gather(x, root=0))
+    np.testing.assert_array_equal(out, x)
+
+
+def test_allgather(comm):
+    x = rank_major((3,), np.float32)
+    out = np.asarray(comm.allgather(x))
+    assert out.shape == (SIZE, SIZE, 3)
+    for r in range(SIZE):
+        np.testing.assert_array_equal(out[r], x)
+
+
+def test_alltoall(comm):
+    x = rank_major((SIZE, 2), np.float32)
+    out = np.asarray(comm.alltoall(x))
+    for r in range(SIZE):
+        for s in range(SIZE):
+            np.testing.assert_array_equal(out[r, s], x[s, r])
+
+
+def test_scatter(comm):
+    x = rank_major((4,), np.float32)
+    out = np.asarray(comm.scatter(x, root=0))
+    np.testing.assert_array_equal(out, x)
+
+
+@pytest.mark.parametrize("source,dest", [(0, 5), (3, 1), (7, 0)])
+def test_send_recv(comm, source, dest):
+    x = rank_major((3,), np.float32)
+    out = np.asarray(comm.send(x, dest=dest, source=source))
+    np.testing.assert_array_equal(out[dest], x[source])
+    for r in range(SIZE):
+        if r != dest:
+            np.testing.assert_array_equal(out[r], x[r])
+
+
+def test_stack_unstack(comm):
+    per_rank = [np.full((2,), r, np.float32) for r in range(SIZE)]
+    stacked = comm.stack(per_rank)
+    back = comm.unstack(stacked)
+    for r in range(SIZE):
+        np.testing.assert_array_equal(back[r], per_rank[r])
+
+
+def test_obj_roundtrip(comm):
+    obj = {"vocab": ["a", "b"], "n": 3}
+    assert comm.bcast_obj(obj) == obj
+    gathered = comm.gather_obj(obj)
+    assert len(gathered) == SIZE and all(g == obj for g in gathered)
+    assert comm.allreduce_obj(1) == SIZE
+    comm.send_obj([1, 2], dest=1)
+    assert comm.recv_obj(source=0) == [1, 2]
+
+
+def test_topology_properties(comm):
+    assert comm.size == SIZE
+    assert 0 <= comm.rank < SIZE
+    assert comm.intra_size * comm.inter_size >= comm.size
+    assert comm.inter_size == 1  # single host in tests
+
+
+def test_multi_node_mean_grad(comm):
+    grads = {
+        "w": rank_major((3, 3), np.float32, seed=1),
+        "b": rank_major((3,), np.float32, seed=2),
+    }
+    out = comm.multi_node_mean_grad(grads)
+    for k in grads:
+        o = np.asarray(out[k])
+        for r in range(SIZE):
+            np.testing.assert_allclose(o[r], grads[k].mean(0), rtol=1e-5)
+
+
+def test_xla_matches_naive_oracle():
+    naive = mn.create_communicator("naive", size=SIZE)
+    xla = mn.create_communicator("xla")
+    x = rank_major((SIZE, 3), np.float32)
+    for op_name, args in [
+        ("allreduce", (x,)),
+        ("bcast", (x,)),
+        ("allgather", (x,)),
+        ("alltoall", (x,)),
+    ]:
+        a = np.asarray(getattr(naive, op_name)(*args))
+        b = np.asarray(getattr(xla, op_name)(*args))
+        np.testing.assert_allclose(a, b, rtol=1e-5, err_msg=op_name)
+
+
+def test_split():
+    xla = mn.create_communicator("xla")
+    colors = [0, 0, 0, 0, 1, 1, 1, 1]
+    subs = xla.split(colors)
+    assert set(subs) == {0, 1}
+    assert subs[0].size == 4 and subs[1].size == 4
+    x = np.arange(4, dtype=np.float32).reshape(4, 1)
+    out = np.asarray(subs[1].allreduce(x))
+    np.testing.assert_allclose(out, np.full((4, 1), 6.0))
+
+
+def test_broadcast_data():
+    xla = mn.create_communicator("xla")
+    params = {"w": np.ones((4, 4), np.float32)}
+    rep = xla.broadcast_data(params)
+    assert rep["w"].sharding.is_fully_replicated
+    np.testing.assert_array_equal(np.asarray(rep["w"]), params["w"])
+
+
+def test_create_communicator_unknown():
+    with pytest.raises(ValueError):
+        mn.create_communicator("definitely_not_a_backend")
