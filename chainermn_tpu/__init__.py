@@ -9,6 +9,16 @@ or mpi4py anywhere in the import graph.
 """
 
 from . import ops  # noqa: F401
+from .datasets import (  # noqa: F401
+    ScatteredDataset,
+    SubDataset,
+    create_empty_dataset,
+    scatter_dataset,
+    scatter_index,
+)
+from .evaluators import accuracy_evaluator, create_multi_node_evaluator  # noqa: F401
+from .optimizers import create_multi_node_optimizer, gradient_average  # noqa: F401
+from .train import make_train_step, replicate, shard_batch  # noqa: F401
 from .communicators import (  # noqa: F401
     CommunicatorBase,
     NaiveCommunicator,

@@ -46,8 +46,21 @@ def create_communicator(
     """
     name = communicator_name.lower()
     if name == "naive":
+        if mesh is not None or devices is not None:
+            raise ValueError(
+                "naive is device-free; pass size=..., not mesh/devices")
         return NaiveCommunicator(size=size)
     if name in _ALIASES:
+        if size is not None:
+            # Honor the requested world size with the first `size` chips.
+            if mesh is not None or devices is not None:
+                raise ValueError("pass either size or mesh/devices, not both")
+            import jax
+            all_devices = jax.devices()
+            if len(all_devices) < size:
+                raise ValueError(
+                    f"size={size} requested but only {len(all_devices)} devices")
+            devices = all_devices[:size]
         kwargs = {}
         if axis_name is not None:
             kwargs["axis_name"] = axis_name

@@ -127,7 +127,15 @@ class NaiveCommunicator(CommunicatorBase):
     def multi_node_mean_grad(self, grads):
         return jax.tree_util.tree_map(lambda g: self.allreduce(g, op="mean"), grads)
 
-    def split(self, color: int, key: int) -> "NaiveCommunicator":
-        # Loopback has no real rank identity; splitting yields a fresh
-        # loopback of unknown membership — callers pass an explicit size.
-        return NaiveCommunicator(size=1)
+    def split(self, color, key: int = 0):
+        # Same contract as CommunicatorBase.split: scalar color = everyone in
+        # one group (whole world); per-rank sequence = {color: communicator}
+        # sized by group membership.
+        if isinstance(color, int):
+            return NaiveCommunicator(size=self._size)
+        if len(color) != self._size:
+            raise ValueError(f"need {self._size} colors, got {len(color)}")
+        groups = {}
+        for c in color:
+            groups[int(c)] = groups.get(int(c), 0) + 1
+        return {c: NaiveCommunicator(size=n) for c, n in sorted(groups.items())}

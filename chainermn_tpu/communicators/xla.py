@@ -23,7 +23,6 @@ Data model: rank-major stacked global arrays — see ``base.py`` docstring.
 from __future__ import annotations
 
 import pickle
-from functools import partial
 from typing import Any, Callable, Dict, List, Optional, Sequence, Union
 
 import jax

@@ -1,0 +1,84 @@
+"""Distributed evaluation.
+
+Reference parity: ``chainermn/evaluators/__init__.py ::
+create_multi_node_evaluator`` [uv] (SURVEY.md §2.6) — each rank evaluates
+its dataset shard, then the results dict is allreduce-averaged so every rank
+reports the global metrics.
+
+TPU-native: an evaluator is any callable ``(shard) -> dict[str, float]``;
+the wrapper runs it per rank shard and averages (weighted by shard example
+counts, so unequal shards don't bias the mean).  The reference subclassed
+Chainer's Evaluator dynamically; here composition replaces inheritance.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Mapping, Sequence
+
+import numpy as np
+
+from .communicators.base import CommunicatorBase
+from .datasets import ScatteredDataset
+
+
+def create_multi_node_evaluator(actual_evaluator: Callable, communicator: CommunicatorBase):
+    """Wrap ``actual_evaluator`` for multi-rank evaluation.
+
+    ``actual_evaluator(shard) -> Mapping[str, float]`` evaluates one rank's
+    data.  The returned wrapper accepts a :class:`ScatteredDataset` (or a
+    sequence of per-rank shards) and returns the cross-rank weighted mean of
+    every metric — what each reference rank would see after
+    ``allreduce_obj`` averaging.
+    """
+
+    def evaluate(scattered) -> Dict[str, float]:
+        shards: Sequence = (
+            [scattered.shard(r) for r in range(len(scattered))]
+            if isinstance(scattered, ScatteredDataset)
+            else list(scattered)
+        )
+        totals: Dict[str, float] = {}
+        weights: Dict[str, float] = {}
+        for shard in shards:
+            result: Mapping[str, float] = actual_evaluator(shard)
+            w = float(len(shard)) if hasattr(shard, "__len__") else 1.0
+            for k, v in result.items():
+                totals[k] = totals.get(k, 0.0) + float(v) * w
+                weights[k] = weights.get(k, 0.0) + w
+        # Cross-process combine: ship (weighted-sum, weight) pairs so the
+        # global mean stays example-weighted even when hosts hold unequal
+        # shard counts.  Identity single-process (all shards local).
+        if communicator.inter_size > 1:
+            summed = communicator.allreduce_obj(
+                {k: (totals[k], weights[k]) for k in totals},
+                op=lambda a, b: {k: (a[k][0] + b[k][0], a[k][1] + b[k][1]) for k in a},
+            )
+            return {k: s / w for k, (s, w) in summed.items()}
+        return {k: totals[k] / weights[k] for k in totals}
+
+    return evaluate
+
+
+def accuracy_evaluator(predict_fn: Callable, batch_size: int = 256):
+    """Convenience: classification loss/accuracy evaluator over a shard.
+
+    ``predict_fn(xs) -> logits``.  Shard items must be ``(x, label)`` pairs.
+    """
+
+    def evaluate(shard) -> Dict[str, float]:
+        n = len(shard)
+        correct, total, loss_sum = 0, 0, 0.0
+        for start in range(0, n, batch_size):
+            items = [shard[i] for i in range(start, min(start + batch_size, n))]
+            xs = np.stack([x for x, _ in items])
+            ys = np.asarray([y for _, y in items])
+            logits = np.asarray(predict_fn(xs))
+            shifted = logits - logits.max(axis=-1, keepdims=True)
+            logp = shifted - np.log(np.exp(shifted).sum(axis=-1, keepdims=True))
+            loss_sum += float(-logp[np.arange(len(ys)), ys].sum())
+            correct += int((logits.argmax(-1) == ys).sum())
+            total += len(ys)
+        return {"validation/loss": loss_sum / max(total, 1),
+                "validation/accuracy": correct / max(total, 1)}
+
+    return evaluate

@@ -1,0 +1,33 @@
+"""MNIST-scale MLP.
+
+Reference parity: the 3-layer MLP of ``examples/mnist/train_mnist.py`` [uv]
+(units-hidden → units-hidden → 10, ReLU), the model behind BASELINE
+config #1.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MLP(nn.Module):
+    n_units: int = 1000
+    n_out: int = 10
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(self.n_units)(x))
+        x = nn.relu(nn.Dense(self.n_units)(x))
+        return nn.Dense(self.n_out)(x)
+
+
+def cross_entropy_loss(logits, labels):
+    logp = jnp.take_along_axis(
+        nn.log_softmax(logits), labels[:, None], axis=-1)
+    return -logp.mean()
+
+
+def accuracy(logits, labels):
+    return (logits.argmax(-1) == labels).mean()

@@ -20,21 +20,21 @@ from __future__ import annotations
 from typing import Optional
 
 import jax
-import jax.numpy as jnp
 
 from ..topology import DEFAULT_AXIS_NAME
 
 
 def _axis_bound(axis_name: str) -> bool:
-    """True when `axis_name` is a bound SPMD axis in the current trace."""
+    """True when `axis_name` is a bound SPMD axis in the current trace.
+
+    Only the unbound-axis error (NameError in current JAX) means "not SPMD";
+    anything else propagates — silently treating an unexpected failure as
+    unbound would turn gradient averaging into identity and corrupt training.
+    """
     try:
         jax.lax.axis_index(axis_name)
         return True
     except NameError:
-        return False
-    except Exception:
-        # jax raises NameError for unbound axes today; be defensive about the
-        # exact exception type across versions.
         return False
 
 

@@ -1,0 +1,112 @@
+"""Multi-node optimizer wrappers.
+
+Reference parity: ``chainermn/optimizers.py`` [uv] (SURVEY.md §2.4):
+
+* ``create_multi_node_optimizer(actual_optimizer, communicator,
+  double_buffering=False)`` — wraps any optimizer so that ``update`` first
+  averages gradients across ranks, then applies the wrapped optimizer.
+* ``_DoubleBufferingOptimizer`` — overlaps the allreduce of step *t*'s
+  gradients with step *t+1*'s compute by applying the 1-step-stale averaged
+  gradients (SURVEY.md §3.3).
+
+TPU-native: the "optimizer" is an ``optax.GradientTransformation`` and the
+wrapper is itself one, so it composes with the whole optax ecosystem.  The
+gradient average is ``lax.pmean`` *inside* the jitted SPMD step — XLA fuses
+it into the step program and schedules the ICI transfer to overlap with
+backprop (the reference needed hand-written CUDA-stream double buffering to
+get that overlap; under XLA the async scheduler does it, and the
+double-buffering variant below exists to reproduce the reference's *stale
+gradient semantics*, which its tests depend on).
+
+Under plain pjit (shardings instead of an explicit axis) the axis is unbound
+and ``pmean_if_bound`` is identity: XLA's sharding propagation already
+produces globally-averaged gradients from a mean loss over the global batch.
+
+Note on shard_map semantics (JAX ≥0.9 VMA tracking): autodiff w.r.t.
+*replicated* params inserts the cross-rank psum of cotangents itself, so
+gradients arriving here are already global and replicated — and
+``pmean_if_bound`` of a replicated value is identity, so the wrapper is
+correct in every regime: real averaging under ``pmap``/per-device params,
+no-op under shard_map-with-replicated-params and under pjit.  The train-step
+builder (`chainermn_tpu.train`) differentiates ``pmean(loss)`` so the
+AD-inserted psum carries the 1/size factor.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Union
+
+import jax
+import optax
+
+from .communicators.base import CommunicatorBase
+from .ops.collective import pmean_if_bound
+from .topology import DEFAULT_AXIS_NAME
+
+
+def _resolve_axis(communicator: Union[CommunicatorBase, str, None]) -> Optional[str]:
+    if communicator is None:
+        return DEFAULT_AXIS_NAME
+    if isinstance(communicator, str):
+        return communicator
+    return getattr(communicator, "axis_name", DEFAULT_AXIS_NAME)
+
+
+def gradient_average(communicator=None) -> optax.GradientTransformation:
+    """An optax transform that means gradients across the communicator axis.
+
+    Reference analog: ``communicator.multi_node_mean_grad(model)`` called by
+    ``_MultiNodeOptimizer.update`` [uv] — but fused into the step program.
+    """
+    axis_name = _resolve_axis(communicator)
+
+    def init_fn(params):
+        del params
+        return optax.EmptyState()
+
+    def update_fn(updates, state, params=None):
+        del params
+        return pmean_if_bound(updates, axis_name), state
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+class DoubleBufferState(NamedTuple):
+    inner: optax.OptState
+    stale_grads: optax.Updates  # averaged grads of the previous step
+
+
+def create_multi_node_optimizer(
+    actual_optimizer: optax.GradientTransformation,
+    communicator=None,
+    double_buffering: bool = False,
+    zero_fill: bool = True,
+) -> optax.GradientTransformation:
+    """Wrap ``actual_optimizer`` with cross-rank gradient averaging.
+
+    Reference: ``create_multi_node_optimizer`` [uv].  ``zero_fill`` mirrors
+    the reference flag: the double-buffered first step applies zero updates
+    (gradient buffers start zero-filled).
+    """
+    if not double_buffering:
+        return optax.chain(gradient_average(communicator), actual_optimizer)
+
+    axis_name = _resolve_axis(communicator)
+
+    def init_fn(params):
+        if not zero_fill:
+            raise NotImplementedError(
+                "double_buffering requires zero_fill=True (matches reference: "
+                "grad buffers start zeroed)")
+        zeros = jax.tree_util.tree_map(jax.numpy.zeros_like, params)
+        return DoubleBufferState(inner=actual_optimizer.init(params), stale_grads=zeros)
+
+    def update_fn(grads, state, params=None):
+        # Average THIS step's grads (XLA overlaps the collective with
+        # whatever compute follows), but apply the PREVIOUS step's average —
+        # exactly the reference's 1-step staleness.
+        fresh = pmean_if_bound(grads, axis_name)
+        updates, inner = actual_optimizer.update(state.stale_grads, state.inner, params)
+        return updates, DoubleBufferState(inner=inner, stale_grads=fresh)
+
+    return optax.GradientTransformation(init_fn, update_fn)

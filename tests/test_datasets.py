@@ -1,0 +1,80 @@
+"""Dataset scattering tests.
+
+Reference parity: ``tests/datasets_tests/test_scatter_dataset.py`` [uv]
+(SURVEY.md §4) — partition coverage/disjointness for all (size, shuffle)
+combos; empty dataset length preservation.
+"""
+
+import numpy as np
+import pytest
+
+import chainermn_tpu as mn
+
+
+@pytest.mark.parametrize("n", [16, 17, 23, 8, 3])
+@pytest.mark.parametrize("shuffle", [False, True])
+def test_scatter_partition(n, shuffle):
+    comm = mn.create_communicator("naive", size=8)
+    data = list(range(n))
+    scattered = mn.scatter_dataset(data, comm, shuffle=shuffle, seed=42,
+                                   force_equal_length=False)
+    all_idx = np.concatenate([scattered.shard(r).indices for r in range(8)])
+    assert sorted(all_idx.tolist()) == list(range(n))  # coverage + disjoint
+    sizes = [len(scattered.shard(r).indices) for r in range(8)]
+    assert max(sizes) - min(sizes) <= 1  # balanced
+
+
+def test_scatter_equal_length_padding():
+    comm = mn.create_communicator("naive", size=8)
+    scattered = mn.scatter_dataset(list(range(17)), comm)
+    lens = {len(scattered.shard(r)) for r in range(8)}
+    assert lens == {3}  # every rank sees the max shard length
+    # short shards are padded by continuing around the permutation circle
+    assert scattered.shard(7).indices.tolist() == [15, 16, 0]
+    # negative indices resolve against the virtual length
+    assert scattered.shard(7)[-1] == scattered.shard(7)[2]
+
+
+def test_scatter_tiny_dataset_smaller_than_world():
+    comm = mn.create_communicator("naive", size=8)
+    scattered = mn.scatter_dataset(list(range(3)), comm)
+    for r in range(8):
+        assert len(scattered.shard(r)) == 1
+        assert scattered.shard(r)[0] in (0, 1, 2)  # no crash on empty shards
+
+
+def test_scatter_no_shuffle_is_contiguous():
+    comm = mn.create_communicator("naive", size=8)
+    scattered = mn.scatter_dataset(list(range(16)), comm, shuffle=False)
+    np.testing.assert_array_equal(scattered.shard(0).indices, [0, 1])
+    np.testing.assert_array_equal(scattered.shard(7).indices, [14, 15])
+
+
+def test_scatter_shuffle_deterministic_seed():
+    comm = mn.create_communicator("naive", size=8)
+    a = mn.scatter_dataset(list(range(32)), comm, shuffle=True, seed=7)
+    b = mn.scatter_dataset(list(range(32)), comm, shuffle=True, seed=7)
+    for r in range(8):
+        np.testing.assert_array_equal(a.shard(r).indices, b.shard(r).indices)
+
+
+def test_empty_dataset():
+    ds = mn.create_empty_dataset(list(range(100)))
+    assert len(ds) == 100
+    assert ds[0] == () and ds[99] == ()
+    with pytest.raises(IndexError):
+        ds[100]
+
+
+def test_scatter_index():
+    comm = mn.create_communicator("naive", size=8)
+    ranges = mn.scatter_index(20, comm)
+    assert ranges[0] == (0, 3) and ranges[-1] == (18, 20)
+    assert sum(b - a for a, b in ranges) == 20
+
+
+def test_subdataset_getitem_errors():
+    comm = mn.create_communicator("naive", size=8)
+    scattered = mn.scatter_dataset(list(range(16)), comm)
+    with pytest.raises(IndexError):
+        scattered.shard(0)[10]

@@ -1,0 +1,99 @@
+"""Optimizer wrapper tests.
+
+Reference parity: ``tests/optimizer_tests/test_multi_node_optimizer.py``
+[uv] (SURVEY.md §4) — wrapped update equals update with the MEAN of
+per-rank gradients; double-buffering applies 1-step-stale means.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import chainermn_tpu as mn
+
+SIZE = 8
+
+
+def make_mesh_and_sharded_batch(seed=0):
+    mesh = mn.make_mesh()
+    rng = np.random.RandomState(seed)
+    xs = rng.randn(SIZE * 4, 3).astype(np.float32)
+    ys = rng.randn(SIZE * 4, 1).astype(np.float32)
+    return mesh, (xs, ys)
+
+
+def loss_fn(params, batch):
+    xs, ys = batch
+    pred = xs @ params["w"] + params["b"]
+    return jnp.mean((pred - ys) ** 2)
+
+
+def init_params():
+    return {"w": jnp.zeros((3, 1)), "b": jnp.zeros((1,))}
+
+
+def test_wrapped_update_equals_global_gradient():
+    """SPMD step with per-rank shards == single-device step on full batch."""
+    mesh, batch = make_mesh_and_sharded_batch()
+    opt = mn.create_multi_node_optimizer(optax.sgd(0.1), mn.create_communicator("xla"))
+
+    step = mn.make_train_step(loss_fn, opt, mesh=mesh)
+    params = mn.replicate(init_params(), mesh)
+    opt_state = mn.replicate(opt.init(params), mesh)
+    sharded = mn.shard_batch(batch, mesh)
+    params_spmd, _, loss_spmd = step(params, opt_state, sharded)
+
+    # oracle: plain single-device SGD on the full batch
+    params_ref = init_params()
+    g = jax.grad(loss_fn)(params_ref, batch)
+    params_ref = jax.tree_util.tree_map(lambda p, g: p - 0.1 * g, params_ref, g)
+
+    for k in params_ref:
+        np.testing.assert_allclose(
+            np.asarray(params_spmd[k]), np.asarray(params_ref[k]), rtol=1e-5)
+    np.testing.assert_allclose(float(loss_spmd), float(loss_fn(init_params(), batch)), rtol=1e-5)
+
+
+def test_double_buffering_staleness():
+    """Step 0 applies zero updates; step t applies step t-1's mean grads."""
+    mesh, batch = make_mesh_and_sharded_batch()
+    comm = mn.create_communicator("xla")
+    opt = mn.create_multi_node_optimizer(optax.sgd(0.1), comm, double_buffering=True)
+
+    step = mn.make_train_step(loss_fn, opt, mesh=mesh, donate=False)
+    params0 = mn.replicate(init_params(), mesh)
+    opt_state = mn.replicate(opt.init(params0), mesh)
+    sharded = mn.shard_batch(batch, mesh)
+
+    params1, opt_state, _ = step(params0, opt_state, sharded)
+    # staleness: first step must be a no-op on params (zero-filled buffers)
+    for k in params1:
+        np.testing.assert_allclose(np.asarray(params1[k]), np.asarray(params0[k]))
+
+    params2, opt_state, _ = step(params1, opt_state, sharded)
+    # second step applies step 1's (fresh at t=1, stale now) global mean grads
+    g = jax.grad(loss_fn)(init_params(), batch)
+    want = jax.tree_util.tree_map(lambda p, g: p - 0.1 * g, init_params(), g)
+    for k in want:
+        np.testing.assert_allclose(
+            np.asarray(params2[k]), np.asarray(want[k]), rtol=1e-5)
+
+
+def test_gradient_average_identity_outside_spmd():
+    """Outside shard_map the wrapper degrades to the plain optimizer."""
+    opt = mn.create_multi_node_optimizer(optax.sgd(0.1), mn.create_communicator("naive", size=1))
+    params = init_params()
+    state = opt.init(params)
+    grads = {"w": jnp.ones((3, 1)), "b": jnp.ones((1,))}
+    updates, _ = jax.jit(opt.update)(grads, state, params)
+    np.testing.assert_allclose(np.asarray(updates["w"]), -0.1 * np.ones((3, 1)), rtol=1e-6)
+
+
+def test_double_buffering_requires_zero_fill():
+    with pytest.raises(NotImplementedError):
+        opt = mn.create_multi_node_optimizer(
+            optax.sgd(0.1), None, double_buffering=True, zero_fill=False)
+        opt.init(init_params())

@@ -1,0 +1,78 @@
+"""End-to-end MNIST slice (BASELINE config #1).
+
+Reference parity: CI smoke of ``examples/mnist`` under ``mpiexec -n 2``
+(SURVEY.md §4 "Integration tests") — here the example's machinery runs on
+the 8-device virtual mesh and must actually learn.
+"""
+
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import chainermn_tpu as mn
+from chainermn_tpu.models import MLP, accuracy, cross_entropy_loss
+
+
+def test_mnist_learns_end_to_end():
+    comm = mn.create_communicator("xla")
+    mesh = comm.mesh
+    rng = np.random.RandomState(0)
+    w_true = rng.randn(784, 10).astype(np.float32)
+    xs = rng.rand(512, 784).astype(np.float32)
+    ys = (xs @ w_true).argmax(-1).astype(np.int32)
+
+    model = MLP(n_units=64)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 784)))
+    opt = mn.create_multi_node_optimizer(optax.adam(1e-3), comm)
+
+    def loss_fn(p, batch):
+        bx, by = batch
+        logits = model.apply(p, bx)
+        return cross_entropy_loss(logits, by), accuracy(logits, by)
+
+    step = mn.make_train_step(loss_fn, opt, mesh=mesh, has_aux=True, donate=False)
+    params = mn.replicate(params, mesh)
+    opt_state = mn.replicate(opt.init(params), mesh)
+    batch = mn.shard_batch((xs, ys), mesh)
+
+    first_loss = None
+    for i in range(40):
+        params, opt_state, loss, acc = step(params, opt_state, batch)
+        # Block every step: with N virtual devices on few host cores, letting
+        # async dispatch run many steps ahead can starve a device thread past
+        # XLA's CPU collective-rendezvous timeout (hard abort).
+        loss = float(loss)
+        if first_loss is None:
+            first_loss = loss
+    assert loss < first_loss * 0.7, (first_loss, loss)
+    assert float(acc) > 0.5
+
+
+def test_evaluator_end_to_end():
+    comm = mn.create_communicator("xla")
+    data = [(np.full((4,), i, np.float32), i % 2) for i in range(64)]
+    scattered = mn.scatter_dataset(data, comm)
+
+    def predict(xs):
+        # "perfect" classifier on label parity
+        parity = (xs[:, 0].astype(np.int32) % 2)
+        return np.eye(2, dtype=np.float32)[parity] * 10
+
+    evaluator = mn.create_multi_node_evaluator(mn.accuracy_evaluator(predict), comm)
+    metrics = evaluator(scattered)
+    assert metrics["validation/accuracy"] == 1.0
+    assert metrics["validation/loss"] < 0.01
+
+
+def test_example_cli_smoke():
+    out = subprocess.run(
+        [sys.executable, "examples/mnist/train_mnist.py",
+         "--devices", "8", "--epoch", "1", "--n-train", "512",
+         "--n-val", "128", "--batchsize", "16", "--unit", "32"],
+        capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "validation/accuracy" in out.stdout
