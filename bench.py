@@ -1,0 +1,87 @@
+#!/usr/bin/env python
+"""Headline benchmark: ResNet-50 training throughput per chip.
+
+Matches `BASELINE.json :: metric` ("ResNet-50 images/sec/chip").  The
+baseline per-chip figure is derived from the reference's published headline
+run (BASELINE.md): 1.28M ImageNet images x 90 epochs in 15 min on 1024
+P100s => ~125 images/sec/chip end-to-end.  vs_baseline = ours / 125.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": N}
+
+Runs on whatever chips are visible (the driver gives one real TPU chip);
+the full training step — bf16 ResNet-50 fwd+bwd, SGD+momentum+weight decay,
+cross-rank gradient mean, BN-stat sync — is the same SPMD program the
+multi-chip path uses.
+"""
+
+import json
+import time
+
+REFERENCE_IMAGES_PER_SEC_PER_CHIP = 125.0  # ChainerMN 1024xP100 headline run
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    import chainermn_tpu as mn
+    from chainermn_tpu.models.mlp import cross_entropy_loss
+    from chainermn_tpu.models.resnet import ResNet50
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    per_chip_batch = 128 if on_tpu else 8
+    image_size = 224 if on_tpu else 32
+    steps = 20 if on_tpu else 2
+
+    comm = mn.create_communicator("xla")
+    mesh = comm.mesh
+    n_chips = comm.size
+    global_batch = per_chip_batch * n_chips
+
+    model = ResNet50(stem_strides=2 if image_size >= 64 else 1)
+    variables = dict(model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, image_size, image_size, 3)),
+        train=False))
+    optimizer = mn.create_multi_node_optimizer(
+        optax.chain(optax.add_decayed_weights(1e-4),
+                    optax.sgd(0.1, momentum=0.9)),
+        comm)
+
+    def loss_and_metrics(logits, batch):
+        return cross_entropy_loss(logits, batch[1]), {}
+
+    step = mn.make_flax_train_step(model, loss_and_metrics, optimizer, mesh=mesh)
+    variables = mn.replicate(variables, mesh)
+    opt_state = mn.replicate(optimizer.init(variables["params"]), mesh)
+
+    rng = np.random.RandomState(0)
+    batch = mn.shard_batch(
+        (rng.randn(global_batch, image_size, image_size, 3).astype(np.float32),
+         rng.randint(0, 1000, global_batch).astype(np.int32)),
+        mesh)
+
+    # compile + warmup
+    for _ in range(2):
+        variables, opt_state, loss, _ = step(variables, opt_state, batch)
+    loss.block_until_ready()
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        variables, opt_state, loss, _ = step(variables, opt_state, batch)
+    loss.block_until_ready()
+    dt = time.perf_counter() - t0
+
+    ips_per_chip = steps * global_batch / dt / n_chips
+    print(json.dumps({
+        "metric": "resnet50_images_per_sec_per_chip",
+        "value": round(ips_per_chip, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(ips_per_chip / REFERENCE_IMAGES_PER_SEC_PER_CHIP, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -8,7 +8,7 @@ parallelism is sharding + ppermute instead of MPI send/recv.  No CUDA, NCCL
 or mpi4py anywhere in the import graph.
 """
 
-from . import ops  # noqa: F401
+from . import functions, links, ops  # noqa: F401
 from .datasets import (  # noqa: F401
     ScatteredDataset,
     SubDataset,
@@ -18,7 +18,12 @@ from .datasets import (  # noqa: F401
 )
 from .evaluators import accuracy_evaluator, create_multi_node_evaluator  # noqa: F401
 from .optimizers import create_multi_node_optimizer, gradient_average  # noqa: F401
-from .train import make_train_step, replicate, shard_batch  # noqa: F401
+from .train import (  # noqa: F401
+    make_flax_train_step,
+    make_train_step,
+    replicate,
+    shard_batch,
+)
 from .communicators import (  # noqa: F401
     CommunicatorBase,
     NaiveCommunicator,

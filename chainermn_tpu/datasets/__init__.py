@@ -101,15 +101,18 @@ def scatter_dataset(
     # Reference split: first (n % size) ranks get one extra element.
     base, extra = divmod(n, size)
     maxlen = base + (1 if extra else 0)
-    shards, start = [], 0
+    shards, start, wrap = [], 0, 0
     for r in range(size):
         ln = base + (1 if r < extra else 0)
         shard = order[start:start + ln]
         if force_equal_length and ln < maxlen:
-            # Pad short/empty shards by continuing around the permutation
-            # circle (reference: SubDataset wrap-padding so every rank runs
-            # the same number of iterations).
-            pad = order[[(start + ln + k) % n for k in range(maxlen - ln)]]
+            # Pad short/empty shards by round-robining the permutation circle
+            # (reference: SubDataset wrap-padding so every rank runs the same
+            # number of iterations).  The rotating cursor keeps pad elements
+            # DISTINCT across ranks — padding every short shard from the same
+            # position would oversample one element.
+            pad = order[[(wrap + k) % n for k in range(maxlen - ln)]]
+            wrap += maxlen - ln
             shard = np.concatenate([shard, pad]) if ln else pad
         shards.append(shard)
         start += ln

@@ -1,0 +1,9 @@
+from .collective import (  # noqa: F401
+    all_to_all,
+    allgather,
+    bcast,
+    gather,
+    scatter,
+)
+from .point_to_point import recv, send  # noqa: F401
+from .pseudo_connect import pseudo_connect  # noqa: F401

@@ -1,0 +1,73 @@
+"""Differentiable collective communication.
+
+Reference parity: ``chainermn/functions/collective_communication.py ::
+AllGather / AllToAll / Bcast / Gather / Scatter`` [uv] (SURVEY.md §2.2).
+Each reference FunctionNode hand-implements backward as the transpose
+collective (bcast ↔ sum-gather, scatter ↔ gather, allgather ↔ alltoall-sum).
+
+TPU-native these are ``jax.lax`` collectives, every one of which already
+carries its transpose rule — the table below is *guaranteed by autodiff*
+rather than hand-maintained (tests/test_functions.py checks the pairings
+numerically):
+
+    =============  ===========================
+    forward        backward (transpose)
+    =============  ===========================
+    all_gather     psum_scatter (alltoall-sum)
+    all_to_all     all_to_all (inverse axes)
+    bcast(root)    psum onto root
+    scatter(root)  gather to root
+    ppermute       ppermute (inverse perm)
+    =============  ===========================
+
+All functions run inside shard_map/pmap with the axis bound, operate on the
+per-rank block, and are the raw material for tensor parallelism exactly as
+the reference's were (SURVEY.md §2.8 "TP").
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..topology import DEFAULT_AXIS_NAME
+
+
+def allgather(x, axis_name: str = DEFAULT_AXIS_NAME, axis: int = 0,
+              tiled: bool = False):
+    """Every rank receives every rank's block (differentiable).
+
+    ``tiled=False`` stacks a new leading axis (reference semantics: a tuple
+    of per-rank arrays); ``tiled=True`` concatenates along ``axis``.
+    """
+    return jax.lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def all_to_all(x, axis_name: str = DEFAULT_AXIS_NAME, split_axis: int = 0,
+               concat_axis: int = 0, tiled: bool = False):
+    """Block-transpose across ranks (differentiable) — the EP/SP substrate."""
+    return jax.lax.all_to_all(x, axis_name, split_axis=split_axis,
+                              concat_axis=concat_axis, tiled=tiled)
+
+
+def bcast(x, root: int = 0, axis_name: str = DEFAULT_AXIS_NAME):
+    """Every rank receives ``root``'s block; backward sums cotangents onto
+    ``root`` (the reference's Bcast/gather-sum pairing)."""
+    masked = jnp.where(jax.lax.axis_index(axis_name) == root, x, jnp.zeros_like(x))
+    return jax.lax.psum(masked, axis_name)
+
+
+def gather(x, root: int = 0, axis_name: str = DEFAULT_AXIS_NAME):
+    """Root receives the stacked blocks (zeros elsewhere); backward scatters
+    the root's cotangent slabs back to their source ranks."""
+    g = jax.lax.all_gather(x, axis_name, axis=0, tiled=False)
+    is_root = jax.lax.axis_index(axis_name) == root
+    return jnp.where(is_root, g, jnp.zeros_like(g))
+
+
+def scatter(x, root: int = 0, axis_name: str = DEFAULT_AXIS_NAME):
+    """Rank r receives slab r of ``root``'s stacked input (leading axis =
+    size); backward gathers cotangents to root."""
+    rooted = bcast(x, root=root, axis_name=axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    return jax.lax.dynamic_index_in_dim(rooted, idx, axis=0, keepdims=False)

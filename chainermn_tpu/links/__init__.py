@@ -1,0 +1,2 @@
+from .multi_node_batch_normalization import MultiNodeBatchNormalization  # noqa: F401
+from .multi_node_chain_list import MultiNodeChainList  # noqa: F401

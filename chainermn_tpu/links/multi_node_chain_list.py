@@ -1,0 +1,121 @@
+"""Model-parallel graph container.
+
+Reference parity: ``chainermn/links/multi_node_chain_list.py ::
+MultiNodeChainList`` [uv] (SURVEY.md §2.3, §3.5, BASELINE config #5).  The
+reference registers sub-chains annotated with ``rank_in``/``rank_out``;
+forward interleaves blocking MPI ``recv → chain → send`` with
+pseudo_connect threading, and autograd replays the messages in reverse.
+
+TPU-native (single-controller): the whole graph traces into ONE
+differentiable jitted program — stage boundaries are data edges, not
+blocking messages, so "autograd across the process boundary" (the
+reference's hard part, §3.5) is just autodiff.  Routing is logical: this
+container preserves the reference's message-passing semantics; *physical*
+placement comes from the shardings of the enclosing jit (pin stage params
+with device_put/shardings at the top level), and the high-throughput
+microbatched SPMD pipeline lives in ``chainermn_tpu.parallel.pipeline``
+(the reference had no schedule at all — SURVEY.md §2.8 "PP: absent").
+The message routing table (who consumes whose output) is exactly the
+reference's:
+
+* ``rank_in=None``  → stage consumes the model input ``x``
+* ``rank_in=r``     → stage consumes the pending message addressed to its
+  rank by an earlier stage with ``rank_out`` covering it
+* ``rank_in=[r...]``→ stage consumes a list of messages (graph join)
+* ``rank_out=None`` → stage's output is the model output
+* ``rank_out=r`` / ``[r...]`` → output is addressed to those ranks (fan-out)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Sequence, Tuple, Union
+
+import jax
+
+from ..communicators.base import CommunicatorBase
+
+Rank = Optional[Union[int, Sequence[int]]]
+
+
+class _Stage:
+    def __init__(self, apply_fn, params, rank: int, rank_in: Rank, rank_out: Rank):
+        self.apply_fn = apply_fn
+        self.params = params
+        self.rank = rank
+        self.rank_in = rank_in
+        self.rank_out = rank_out
+
+
+class MultiNodeChainList:
+    """Sequentially-registered model-parallel graph (reference semantics).
+
+    ``add_link(apply_fn, params, rank, rank_in, rank_out)`` registers a
+    stage owned by chip ``rank``; ``apply_fn(params, x)`` is any jittable
+    callable (a flax ``Module.apply`` closure, a plain function over a
+    pytree, ...).  Stages execute in registration order, exactly like the
+    reference's forward loop.  Call the instance inside ``jax.jit`` for one
+    fused multi-chip executable.
+    """
+
+    def __init__(self, comm: CommunicatorBase):
+        self._comm = comm
+        self._stages: List[_Stage] = []
+
+    def add_link(self, apply_fn: Callable, params: Any, rank: int,
+                 rank_in: Rank = None, rank_out: Rank = None) -> None:
+        if not 0 <= rank < self._comm.size:
+            raise ValueError(f"rank {rank} out of range for size {self._comm.size}")
+        self._stages.append(_Stage(apply_fn, params, rank, rank_in, rank_out))
+
+    def _to_rank(self, value, rank: int):
+        """The logical transfer edge rank→rank.  Placement is decided by the
+        enclosing jit's shardings; inside the traced program this edge is
+        where XLA emits the ICI copy when stages are pinned to chips."""
+        del rank
+        return value
+
+    def params(self) -> List[Any]:
+        """Per-stage parameter pytrees (differentiable argument list for
+        ``__call__(x, params=...)``)."""
+        return [s.params for s in self._stages]
+
+    def __call__(self, x, params: Optional[List[Any]] = None):
+        """Run the graph.  ``params`` overrides stage parameters (so the
+        whole list can be a differentiable argument of a jitted loss)."""
+        if params is None:
+            params = [s.params for s in self._stages]
+        # mailbox[r] = queue of (source_rank, activation) addressed to rank
+        # r, in send order — mirrors the reference's tag-matched MPI recv:
+        # a stage pops the first pending message FROM its declared source
+        mailbox = {r: [] for r in range(self._comm.size)}
+
+        def pop_from(rank: int, source: int):
+            for i, (src, v) in enumerate(mailbox[rank]):
+                if src == source:
+                    return mailbox[rank].pop(i)[1]
+            raise RuntimeError(
+                f"stage on rank {rank} expects a message from rank {source} "
+                "but none is pending — check registration order (reference: "
+                "forward order must match the send/recv pairing)")
+
+        output = None
+        for stage, p in zip(self._stages, params):
+            if stage.rank_in is None:
+                inp = self._to_rank(x, stage.rank)
+            elif isinstance(stage.rank_in, int):
+                inp = self._to_rank(pop_from(stage.rank, stage.rank_in),
+                                    stage.rank)
+            else:  # join: one message per listed source rank, in declared order
+                inp = [self._to_rank(pop_from(stage.rank, src), stage.rank)
+                       for src in stage.rank_in]
+            y = stage.apply_fn(p, inp)
+            if stage.rank_out is None:
+                output = y
+            elif isinstance(stage.rank_out, int):
+                mailbox[stage.rank_out].append((stage.rank, y))
+            else:  # fan-out
+                for r in stage.rank_out:
+                    mailbox[r].append((stage.rank, y))
+        if output is None:
+            raise RuntimeError("no stage declared rank_out=None (model output)")
+        return output

@@ -1,0 +1,120 @@
+"""ResNet family (v1.5) for the ImageNet DP benchmark.
+
+Reference parity: ``examples/imagenet/models/resnet50.py`` [uv]
+(SURVEY.md §2.9) — the headline data-parallel workload (BASELINE configs
+#2/#4 use ResNet-50/152).
+
+TPU-first design: convs and matmuls run in bfloat16 (MXU-native), while
+parameters, BatchNorm statistics and the softmax/loss stay float32 for
+numerical stability — the TPU analog of the reference's
+``allreduce_grad_dtype=float16`` compute/compress split.  Shapes are NHWC
+(XLA:TPU's preferred conv layout).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+ModuleDef = Any
+
+
+class BasicBlock(nn.Module):
+    filters: int
+    strides: int = 1
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-5, dtype=self.dtype)
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        residual = x
+        y = conv(self.filters, (3, 3), strides=(self.strides, self.strides))(x)
+        y = nn.relu(norm()(y))
+        y = conv(self.filters, (3, 3))(y)
+        # zero-init the last BN scale so each block starts as identity —
+        # standard large-batch ResNet trick (Goyal et al.), matters at the
+        # batch sizes DP scaling targets
+        y = norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = conv(self.filters, (1, 1),
+                            strides=(self.strides, self.strides),
+                            name="conv_proj")(residual)
+            residual = norm(name="norm_proj")(residual)
+        return nn.relu(residual + y)
+
+
+class BottleneckBlock(nn.Module):
+    filters: int
+    strides: int = 1
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-5, dtype=self.dtype)
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        residual = x
+        y = nn.relu(norm()(conv(self.filters, (1, 1))(x)))
+        # v1.5: stride lives on the 3x3, not the 1x1
+        y = nn.relu(norm()(conv(self.filters, (3, 3),
+                                strides=(self.strides, self.strides))(y)))
+        y = norm(scale_init=nn.initializers.zeros)(
+            conv(self.filters * 4, (1, 1))(y))
+        if residual.shape != y.shape:
+            residual = conv(self.filters * 4, (1, 1),
+                            strides=(self.strides, self.strides),
+                            name="conv_proj")(residual)
+            residual = norm(name="norm_proj")(residual)
+        return nn.relu(residual + y)
+
+
+class ResNet(nn.Module):
+    stage_sizes: Sequence[int]
+    block_cls: ModuleDef
+    num_classes: int = 1000
+    num_filters: int = 64
+    dtype: Any = jnp.bfloat16
+    stem_strides: int = 2  # small-image variants (CIFAR-style) can use 1
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = x.astype(self.dtype)
+        x = nn.Conv(self.num_filters, (7, 7),
+                    strides=(self.stem_strides, self.stem_strides),
+                    padding=[(3, 3), (3, 3)], use_bias=False,
+                    dtype=self.dtype, name="conv_init")(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         epsilon=1e-5, dtype=self.dtype, name="bn_init")(x)
+        x = nn.relu(x)
+        if self.stem_strides == 2:
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=[(1, 1), (1, 1)])
+        for i, block_count in enumerate(self.stage_sizes):
+            for j in range(block_count):
+                strides = 2 if i > 0 and j == 0 else 1
+                x = self.block_cls(self.num_filters * 2 ** i,
+                                   strides=strides, dtype=self.dtype)(x, train)
+        x = jnp.mean(x, axis=(1, 2))
+        # head in float32: the tiny matmul costs nothing, the logits gain
+        # a lot of precision
+        x = nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+        return x
+
+
+ResNet18 = partial(ResNet, stage_sizes=[2, 2, 2, 2], block_cls=BasicBlock)
+ResNet34 = partial(ResNet, stage_sizes=[3, 4, 6, 3], block_cls=BasicBlock)
+ResNet50 = partial(ResNet, stage_sizes=[3, 4, 6, 3], block_cls=BottleneckBlock)
+ResNet101 = partial(ResNet, stage_sizes=[3, 4, 23, 3], block_cls=BottleneckBlock)
+ResNet152 = partial(ResNet, stage_sizes=[3, 8, 36, 3], block_cls=BottleneckBlock)
+
+ARCHS: dict = {
+    "resnet18": ResNet18,
+    "resnet34": ResNet34,
+    "resnet50": ResNet50,
+    "resnet101": ResNet101,
+    "resnet152": ResNet152,
+}

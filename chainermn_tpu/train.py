@@ -74,6 +74,56 @@ def make_train_step(
     return jax.jit(smapped, donate_argnums=(0, 1) if donate else ())
 
 
+def make_flax_train_step(
+    model,
+    loss_and_metrics: Callable,
+    optimizer: optax.GradientTransformation,
+    mesh: Optional[Mesh] = None,
+    axis_name: str = DEFAULT_AXIS_NAME,
+    donate: bool = True,
+):
+    """Train step for flax modules with mutable ``batch_stats`` (BatchNorm).
+
+    ``loss_and_metrics(logits, batch) -> (loss, metrics)`` over the local
+    shard.  Returns ``step(variables, opt_state, batch) -> (variables,
+    opt_state, loss, metrics)`` where ``variables = {'params': ...,
+    'batch_stats': ...}``.  Running BN statistics are pmean-synced across
+    ranks every step, the TPU analog of the reference's
+    ``AllreducePersistent`` keeping eval-time BN consistent
+    (extensions/allreduce_persistent.py [uv]) — but continuously, not as a
+    pre-eval extension.
+    """
+    if mesh is None:
+        mesh = make_mesh(axis_name=axis_name)
+
+    def spmd(variables, opt_state, batch):
+        params = variables["params"]
+        batch_stats = variables.get("batch_stats", {})
+
+        def global_loss(p):
+            out, mutated = model.apply(
+                {"params": p, "batch_stats": batch_stats},
+                batch[0], train=True, mutable=["batch_stats"])
+            loss, metrics = loss_and_metrics(out, batch)
+            return jax.lax.pmean(loss, axis_name), (mutated, metrics)
+
+        (loss, (mutated, metrics)), grads = jax.value_and_grad(
+            global_loss, has_aux=True)(params)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        new_stats = jax.lax.pmean(mutated["batch_stats"], axis_name)
+        metrics = jax.lax.pmean(metrics, axis_name)
+        return ({"params": params, "batch_stats": new_stats},
+                opt_state, loss, metrics)
+
+    smapped = shard_map(
+        spmd, mesh=mesh,
+        in_specs=(P(), P(), P(axis_name)),
+        out_specs=(P(), P(), P(), P()),
+    )
+    return jax.jit(smapped, donate_argnums=(0, 1) if donate else ())
+
+
 def replicate(tree, mesh: Optional[Mesh] = None):
     """Place a pytree replicated over the mesh (params/opt_state)."""
     if mesh is None:

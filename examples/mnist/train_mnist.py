@@ -113,7 +113,9 @@ def main():
 
     evaluator = mn.create_multi_node_evaluator(
         mn.accuracy_evaluator(lambda xs: model.apply(params, jnp.asarray(xs))), comm)
-    metrics = evaluator(mn.scatter_dataset(val, comm))
+    # eval shards stay unequal (no wrap padding) — the evaluator's
+    # example-weighted mean handles that; padding would double-count
+    metrics = evaluator(mn.scatter_dataset(val, comm, force_equal_length=False))
     if comm.rank == 0:
         print({k: round(v, 4) for k, v in metrics.items()})
 

@@ -29,8 +29,10 @@ def test_scatter_equal_length_padding():
     scattered = mn.scatter_dataset(list(range(17)), comm)
     lens = {len(scattered.shard(r)) for r in range(8)}
     assert lens == {3}  # every rank sees the max shard length
-    # short shards are padded by continuing around the permutation circle
-    assert scattered.shard(7).indices.tolist() == [15, 16, 0]
+    # short shards pad round-robin from the permutation circle: shards 1..7
+    # each pad one DISTINCT element (0..6)
+    assert scattered.shard(1).indices.tolist() == [3, 4, 0]
+    assert scattered.shard(7).indices.tolist() == [15, 16, 6]
     # negative indices resolve against the virtual length
     assert scattered.shard(7)[-1] == scattered.shard(7)[2]
 
@@ -38,9 +40,11 @@ def test_scatter_equal_length_padding():
 def test_scatter_tiny_dataset_smaller_than_world():
     comm = mn.create_communicator("naive", size=8)
     scattered = mn.scatter_dataset(list(range(3)), comm)
-    for r in range(8):
-        assert len(scattered.shard(r)) == 1
-        assert scattered.shard(r)[0] in (0, 1, 2)  # no crash on empty shards
+    got = [scattered.shard(r)[0] for r in range(8)]
+    assert all(len(scattered.shard(r)) == 1 for r in range(8))
+    # padding round-robins so no element is oversampled more than necessary
+    counts = {v: got.count(v) for v in set(got)}
+    assert max(counts.values()) - min(counts.values()) <= 1, counts
 
 
 def test_scatter_no_shuffle_is_contiguous():

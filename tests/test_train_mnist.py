@@ -69,8 +69,11 @@ def test_evaluator_end_to_end():
 
 
 def test_example_cli_smoke():
+    import os
+    script = os.path.join(os.path.dirname(__file__), "..",
+                          "examples", "mnist", "train_mnist.py")
     out = subprocess.run(
-        [sys.executable, "examples/mnist/train_mnist.py",
+        [sys.executable, script,
          "--devices", "8", "--epoch", "1", "--n-train", "512",
          "--n-val", "128", "--batchsize", "16", "--unit", "32"],
         capture_output=True, text=True, timeout=300)
