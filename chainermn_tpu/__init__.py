@@ -8,7 +8,17 @@ parallelism is sharding + ppermute instead of MPI send/recv.  No CUDA, NCCL
 or mpi4py anywhere in the import graph.
 """
 
-from . import functions, links, ops  # noqa: F401
+from . import extensions, functions, global_except_hook, iterators, links, ops  # noqa: F401
+from .extensions import (  # noqa: F401
+    AllreducePersistent,
+    ObservationAggregator,
+    create_multi_node_checkpointer,
+)
+from .iterators import (  # noqa: F401
+    SerialIterator,
+    create_multi_node_iterator,
+    create_synchronized_iterator,
+)
 from .datasets import (  # noqa: F401
     ScatteredDataset,
     SubDataset,

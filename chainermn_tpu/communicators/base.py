@@ -159,6 +159,13 @@ class CommunicatorBase:
     def finalize(self) -> None:
         pass
 
+    def owns_rank(self, r: int) -> bool:
+        """Whether THIS controller process owns logical rank ``r`` (always
+        true single-controller; device-ownership check under
+        multi-controller).  Used by host-side components (iterators,
+        checkpointing) to run rank-specific work on exactly one process."""
+        return True
+
     # ---- conveniences shared by all backends ----
     def stack(self, per_rank: Sequence[Any]):
         """Build a rank-major stacked array from a list of per-rank arrays."""

@@ -91,6 +91,9 @@ class XlaCommunicator(CommunicatorBase):
     def inter_size(self) -> int:
         return self._topo.inter_size
 
+    def owns_rank(self, r: int) -> bool:
+        return self._devices[r].process_index == jax.process_index()
+
     # ---- compiled-program cache ----
     def _program(self, key, fn, in_specs=None, out_specs=None):
         if key not in self._progs:

@@ -1,0 +1,17 @@
+"""Training-loop extensions (reference: ``chainermn/extensions/`` — SURVEY.md §2.6)."""
+
+from .allreduce_persistent import AllreducePersistent, allreduce_persistent  # noqa: F401
+from .checkpoint import MultiNodeCheckpointer, create_multi_node_checkpointer  # noqa: F401
+from .observation_aggregator import (  # noqa: F401
+    ObservationAggregator,
+    aggregate_observations,
+)
+
+__all__ = [
+    "AllreducePersistent",
+    "allreduce_persistent",
+    "MultiNodeCheckpointer",
+    "create_multi_node_checkpointer",
+    "ObservationAggregator",
+    "aggregate_observations",
+]

@@ -1,0 +1,49 @@
+"""Synchronize persistent (non-gradient) model state across ranks.
+
+Reference parity: ``chainermn/extensions/allreduce_persistent.py ::
+AllreducePersistent(model, comm)`` [uv] (SURVEY.md §2.6) — a trainer
+extension that allreduce-averages a model's *persistent* values (BatchNorm
+running mean/var, counters) so evaluation is consistent across data-parallel
+ranks whose local batches produced different statistics.
+
+TPU adaptation: operates on a rank-major stacked pytree (the eager
+communicator contract, ``communicators/base.py``); the usual target is a
+flax ``batch_stats`` collection stacked per rank out of a ``shard_map``-ped
+train step.  For fully in-jit training the same sync is a one-line
+``ops.pmean`` inside the step — this extension exists for eager parity and
+for state kept outside the jitted program.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+
+from ..communicators.base import CommunicatorBase
+
+
+def allreduce_persistent(tree: Any, comm: CommunicatorBase) -> Any:
+    """Mean every leaf of a rank-major stacked pytree across ranks."""
+    return jax.tree_util.tree_map(lambda x: comm.allreduce(x, op="mean"), tree)
+
+
+class AllreducePersistent:
+    """Trainer extension: average persistent state across ranks.
+
+    ``state_getter``/``state_setter`` pull and push the persistent pytree on
+    the trainer (default: ``trainer.persistent_state`` attribute), keeping
+    this decoupled from any one model library the way the reference walked
+    Chainer ``Link._persistent`` names [uv].
+    """
+
+    def __init__(self, comm: CommunicatorBase,
+                 state_getter=None, state_setter=None):
+        self.comm = comm
+        self._get = state_getter or (lambda t: getattr(t, "persistent_state", None))
+        self._set = state_setter or (lambda t, v: setattr(t, "persistent_state", v))
+
+    def __call__(self, trainer) -> None:
+        tree = self._get(trainer)
+        if tree is not None:
+            self._set(trainer, allreduce_persistent(tree, self.comm))

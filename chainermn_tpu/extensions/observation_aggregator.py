@@ -1,0 +1,45 @@
+"""Cross-rank averaging of reported observations (losses/metrics).
+
+Reference parity: merged-era ``chainermn/extensions/_observation_aggregator.py
+:: ObservationAggregator`` [uv] (SURVEY.md §2.6) — averages Trainer
+observation scalars across ranks before LogReport so rank 0's log reflects
+the whole job, not its local shard.
+
+TPU adaptation: scalar dicts ride the DCN object lane (``allgather_obj``);
+under a single controller the values are already global and the mean is an
+identity.  Tensor leaves are averaged elementwise.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from ..communicators.base import CommunicatorBase
+
+
+def aggregate_observations(observation: Dict[str, Any],
+                           comm: CommunicatorBase) -> Dict[str, Any]:
+    """Return the across-rank mean of each entry of ``observation``."""
+    gathered = comm.allgather_obj(observation)
+    keys: list = []
+    for g in gathered:  # union, so metrics reported by only some ranks survive
+        keys.extend(k for k in g if k not in keys)
+    out: Dict[str, Any] = {}
+    for key in keys:
+        vals = [np.asarray(g[key], dtype=np.float64) for g in gathered
+                if key in g]
+        out[key] = (np.mean(vals, axis=0) if vals[0].ndim
+                    else float(np.mean(vals)))
+    return out
+
+
+class ObservationAggregator:
+    """Trainer extension: replace ``trainer.observation`` with rank means."""
+
+    def __init__(self, comm: CommunicatorBase):
+        self.comm = comm
+
+    def __call__(self, trainer) -> None:
+        trainer.observation = aggregate_observations(trainer.observation, self.comm)

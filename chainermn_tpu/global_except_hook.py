@@ -1,0 +1,69 @@
+"""Global exception hook: one rank's crash kills the whole job, loudly.
+
+Reference parity: ``chainermn/global_except_hook.py`` [uv] (SURVEY.md §2.6,
+§5 "race detection") — installs a ``sys.excepthook`` that prints the
+traceback then calls ``MPI_Abort`` so an uncaught exception on any rank
+aborts the gang instead of leaving the other ranks deadlocked inside a
+collective.
+
+TPU adaptation: under multi-controller JAX the failure-propagation channel
+is the coordinator — a process that exits non-zero is detected by the
+coordinator's heartbeat and the remaining processes' blocked collectives
+fail with a distributed-runtime error.  The hook prints a rank-prefixed
+traceback, asks the distributed runtime to shut down, then hard-exits so
+the coordinator notices immediately rather than after a collective timeout.
+Single-process behavior is the stock traceback (nothing to abort).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import traceback
+
+_installed = False
+_orig_hook = None
+
+
+def _global_except_hook(exc_type, exc_value, tb) -> None:
+    import jax
+
+    try:
+        nproc = jax.process_count()
+    except Exception:
+        nproc = 1
+    if nproc <= 1:
+        (_orig_hook or sys.__excepthook__)(exc_type, exc_value, tb)
+        return
+    rank = jax.process_index()
+    sys.stderr.write(
+        f"[chainermn_tpu] uncaught exception on process {rank}/{nproc} — "
+        "aborting the whole job (reference analog: MPI_Abort):\n")
+    sys.stderr.write("".join(traceback.format_exception(exc_type, exc_value, tb)))
+    sys.stderr.flush()
+    try:
+        jax.distributed.shutdown()
+    except Exception:
+        pass
+    # Hard exit (not sys.exit): never return into a hung collective.
+    os._exit(1)
+
+
+def add_hook() -> None:
+    """Install the hook (idempotent).  The reference auto-installed at
+    ``import chainermn`` [uv]; here installation is explicit via
+    ``chainermn_tpu.init_distributed`` or a direct call, so importing the
+    library never mutates interpreter state."""
+    global _installed, _orig_hook
+    if _installed:
+        return
+    _orig_hook = sys.excepthook
+    sys.excepthook = _global_except_hook
+    _installed = True
+
+
+def remove_hook() -> None:
+    global _installed
+    if _installed:
+        sys.excepthook = _orig_hook or sys.__excepthook__
+        _installed = False

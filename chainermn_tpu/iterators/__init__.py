@@ -1,0 +1,212 @@
+"""Dataset iterators (multi-node aware).
+
+Reference parity: ``chainermn/iterators/`` — ``create_multi_node_iterator``
+(``iterators/_multi_node_iterator.py`` [uv]) and
+``create_synchronized_iterator`` (``iterators/_synchronized_iterator.py``
+[uv]); SURVEY.md §2.5.  The reference wraps *Chainer's* ``SerialIterator``;
+this framework is standalone so it ships its own :class:`SerialIterator`
+with the same epoch/position/serialization contract, and the multi-node
+wrappers compose with any iterator exposing that contract.
+
+TPU adaptation: the reference's multi-node iterator is a master/slave
+process pair exchanging batches over MPI.  Under a single-controller JAX
+process that owns every rank the *semantics* (all ranks observe the master
+rank's batch stream) are delivered by iterating on the process that owns the
+master rank and broadcasting the batch over DCN (``bcast_obj``); on one
+process this is a passthrough with a defensive copy, exactly how the
+reference behaves under ``mpiexec -n 1``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+import numpy as np
+
+from ..communicators.base import CommunicatorBase
+
+
+class SerialIterator:
+    """Sequential/shuffled minibatch iterator with epoch accounting.
+
+    Standalone analog of Chainer's ``SerialIterator`` (the reference's
+    iterator substrate — external dep, see SURVEY.md §1 note on Chainer
+    sitting below everything).  Supports ``state_dict``/``load_state_dict``
+    so the multi-node checkpointer can resume it mid-epoch.
+    """
+
+    def __init__(self, dataset, batch_size: int, repeat: bool = True,
+                 shuffle: bool = True, seed: Optional[int] = None):
+        self.dataset = dataset
+        self.batch_size = int(batch_size)
+        self.repeat = repeat
+        self.shuffle = shuffle
+        self._seed = seed
+        self._rng = np.random.RandomState(seed)
+        self.epoch = 0
+        self.current_position = 0
+        self.is_new_epoch = False
+        self._order = self._new_order()
+
+    def _new_order(self) -> np.ndarray:
+        n = len(self.dataset)
+        return self._rng.permutation(n) if self.shuffle else np.arange(n)
+
+    @property
+    def epoch_detail(self) -> float:
+        return self.epoch + self.current_position / max(len(self.dataset), 1)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        n = len(self.dataset)
+        if not self.repeat and self.epoch > 0 and self.current_position == 0:
+            raise StopIteration
+        i, stop = self.current_position, min(self.current_position + self.batch_size, n)
+        batch = [self.dataset[int(j)] for j in self._order[i:stop]]
+        if stop >= n:
+            self.epoch += 1
+            self.is_new_epoch = True
+            self.current_position = 0
+            self._order = self._new_order()
+            if self.repeat and len(batch) < self.batch_size:
+                pad = self.batch_size - len(batch)
+                batch.extend(self.dataset[int(j)] for j in self._order[:pad])
+                self.current_position = pad
+        else:
+            self.is_new_epoch = False
+            self.current_position = stop
+        return batch
+
+    next = __next__
+
+    def reset(self) -> None:
+        self._rng = np.random.RandomState(self._seed)
+        self.epoch = 0
+        self.current_position = 0
+        self.is_new_epoch = False
+        self._order = self._new_order()
+
+    # ---- resume contract (consumed by extensions/checkpoint.py) ----
+    def state_dict(self) -> dict:
+        return {
+            "epoch": self.epoch,
+            "current_position": self.current_position,
+            "is_new_epoch": self.is_new_epoch,
+            "order": np.asarray(self._order),
+            "rng_state": self._rng.get_state(),
+        }
+
+    def load_state_dict(self, state: dict) -> None:
+        self.epoch = int(state["epoch"])
+        self.current_position = int(state["current_position"])
+        self.is_new_epoch = bool(state["is_new_epoch"])
+        self._order = np.asarray(state["order"])
+        self._rng.set_state(state["rng_state"])
+
+
+class _MultiNodeIterator:
+    """All ranks observe the master rank's batch stream (bcast per batch)."""
+
+    def __init__(self, actual_iterator, communicator: CommunicatorBase,
+                 rank_master: int):
+        self.actual_iterator = actual_iterator
+        self.communicator = communicator
+        self.rank_master = rank_master
+        self.epoch = 0
+        self.is_new_epoch = False
+        self._epoch_detail = 0.0
+
+    @property
+    def _is_master(self) -> bool:
+        return self.communicator.owns_rank(self.rank_master)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        # Only the process owning the master rank drives the underlying
+        # iterator (non-master processes skip their local input pipeline
+        # entirely); bcast_obj carries (batch, epoch bookkeeping) to
+        # everyone — DCN under multi-controller, a copy under one process.
+        # Reference analog: _MultiNodeIterator master sends
+        # (batch, is_new_epoch) via MPI [uv].
+        stop = False
+        payload = None
+        if self._is_master:
+            try:
+                batch = self.actual_iterator.next()
+                payload = (
+                    batch,
+                    getattr(self.actual_iterator, "epoch", 0),
+                    getattr(self.actual_iterator, "is_new_epoch", False),
+                    getattr(self.actual_iterator, "epoch_detail", 0.0),
+                )
+            except StopIteration:
+                stop = True
+        stop, payload = self.communicator.bcast_obj(
+            (stop, payload), root=self.rank_master)
+        if stop:
+            raise StopIteration
+        batch, self.epoch, self.is_new_epoch, self._epoch_detail = payload
+        return batch
+
+    next = __next__
+
+    @property
+    def epoch_detail(self) -> float:
+        # Reflects the MASTER stream (synced each batch), so epoch triggers
+        # fire identically on every process regardless of local shard sizes.
+        return self._epoch_detail
+
+    def reset(self) -> None:
+        if self._is_master and hasattr(self.actual_iterator, "reset"):
+            self.actual_iterator.reset()
+        self.epoch = 0
+        self.is_new_epoch = False
+        self._epoch_detail = 0.0
+
+    def state_dict(self) -> dict:
+        # The master's state is authoritative; broadcast it so every process
+        # checkpoints an identical, resumable copy.
+        local = (self.actual_iterator.state_dict()
+                 if self._is_master else None)
+        return self.communicator.bcast_obj(local, root=self.rank_master)
+
+    def load_state_dict(self, state: dict) -> None:
+        if self._is_master:
+            self.actual_iterator.load_state_dict(state)
+
+
+def create_multi_node_iterator(actual_iterator, communicator: CommunicatorBase,
+                               rank_master: int = 0):
+    """Replicate one rank's batch stream to all ranks (reference:
+    ``create_multi_node_iterator`` [uv] — model-parallel input replication,
+    exercised by ``examples/model_parallel``)."""
+    return _MultiNodeIterator(actual_iterator, communicator, rank_master)
+
+
+def create_synchronized_iterator(actual_iterator, communicator: CommunicatorBase):
+    """Synchronize the iterator's RNG across ranks so every rank draws the
+    same shuffle order (reference: ``create_synchronized_iterator`` [uv]).
+
+    The master rank's full iterator state (RNG, shuffle order, position) is
+    broadcast and installed into every rank's iterator before use; thereafter
+    all ranks step identical streams.  On a single controller this is an
+    identity (the master's own stream is left untouched).
+    """
+    if not hasattr(actual_iterator, "state_dict"):
+        raise ValueError(
+            "synchronized iterator needs an iterator with state_dict/"
+            "load_state_dict (e.g. chainermn_tpu.iterators.SerialIterator)")
+    state = communicator.bcast_obj(actual_iterator.state_dict(), root=0)
+    actual_iterator.load_state_dict(state)
+    return actual_iterator
+
+
+__all__ = [
+    "SerialIterator",
+    "create_multi_node_iterator",
+    "create_synchronized_iterator",
+]

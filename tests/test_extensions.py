@@ -1,0 +1,142 @@
+"""Extension tests.
+
+Reference parity: ``tests/extensions_tests/test_checkpoint.py`` (save /
+maybe_load round-trip, generation GC) and ``test_allreduce_persistent.py``
+(BN stats averaged) [uv] — SURVEY.md §4 — plus observation aggregation and
+the except hook's single-process passthrough.
+"""
+
+import sys
+
+import numpy as np
+import pytest
+
+import chainermn_tpu as mn
+from chainermn_tpu import global_except_hook
+from chainermn_tpu.extensions import (
+    aggregate_observations,
+    allreduce_persistent,
+    create_multi_node_checkpointer,
+)
+from chainermn_tpu.iterators import SerialIterator
+
+
+@pytest.fixture(scope="module")
+def comm(devices):
+    return mn.create_communicator("xla", devices=devices)
+
+
+@pytest.fixture()
+def naive():
+    return mn.create_communicator("naive", size=4)
+
+
+class TestCheckpointer:
+    def _state(self, step):
+        return {
+            "params": {"w": np.full((3, 3), float(step)), "b": np.arange(3.0)},
+            "step": step,
+        }
+
+    def test_save_maybe_load_roundtrip(self, comm, tmp_path):
+        cp = create_multi_node_checkpointer("job", comm, path=str(tmp_path))
+        assert cp.maybe_load()[1] is None  # fresh start: no-op
+        cp.save(self._state(7), iteration=7)
+        cp.save(self._state(9), iteration=9)
+        loaded, it = cp.maybe_load()
+        assert it == 9
+        np.testing.assert_array_equal(loaded["params"]["w"], np.full((3, 3), 9.0))
+        assert loaded["step"] == 9
+
+    def test_resume_keeps_passed_state_when_empty(self, comm, tmp_path):
+        cp = create_multi_node_checkpointer("job", comm, path=str(tmp_path))
+        template = {"x": 1}
+        state, it = cp.maybe_load(template)
+        assert it is None and state is template
+
+    def test_generation_gc(self, comm, tmp_path):
+        cp = create_multi_node_checkpointer(
+            "job", comm, gc_interval=3, keep=2, path=str(tmp_path))
+        for i in range(1, 8):
+            cp.save(self._state(i), iteration=i)
+        # GC ran after saves 3 (keeps 2,3) and 6 (keeps 5,6); save 7 arrived
+        # after the last GC.
+        assert cp.get_generations() == [5, 6, 7]
+
+    def test_world_size_mismatch_fails_loudly(self, comm, tmp_path):
+        cp = create_multi_node_checkpointer("job", comm, path=str(tmp_path))
+        cp.save(self._state(1), iteration=1)
+        # Simulate a restart with a different world size by renaming the
+        # shard's world-size tag.
+        import os
+        (old,) = [f for f in os.listdir(tmp_path) if not f.startswith(".")]
+        os.rename(tmp_path / old, tmp_path / old.replace("of1", "of4"))
+        with pytest.raises(RuntimeError, match="world size"):
+            cp.maybe_load()
+
+    def test_iterator_state_checkpointable(self, comm, tmp_path):
+        ds = [(np.float32(i), i % 2) for i in range(20)]
+        it = SerialIterator(ds, 3, shuffle=True, seed=0)
+        for _ in range(3):
+            it.next()
+        cp = create_multi_node_checkpointer("it", comm, path=str(tmp_path))
+        cp.save({"iterator": it.state_dict()}, iteration=3)
+        expect = [x[0] for x in it.next()]
+        loaded, _ = cp.maybe_load()
+        it2 = SerialIterator(ds, 3, shuffle=True, seed=99)
+        it2.load_state_dict(loaded["iterator"])
+        assert [x[0] for x in it2.next()] == expect
+
+    def test_device_arrays_detached(self, comm, tmp_path):
+        import jax.numpy as jnp
+        cp = create_multi_node_checkpointer("dev", comm, path=str(tmp_path))
+        cp.save({"p": jnp.ones((4,))}, iteration=1)
+        loaded, _ = cp.maybe_load()
+        assert isinstance(loaded["p"], np.ndarray)
+
+    def test_finalize_cleans_up(self, comm, tmp_path):
+        cp = create_multi_node_checkpointer("job", comm, path=str(tmp_path))
+        cp.save(self._state(1), iteration=1)
+        cp.finalize()
+        assert cp.maybe_load()[1] is None
+
+
+class TestAllreducePersistent:
+    def test_bn_stats_averaged(self, naive):
+        # 4 ranks with divergent running stats → synced to the mean.
+        stacked = {
+            "mean": np.stack([np.full(5, r, np.float32) for r in range(4)]),
+            "var": np.stack([np.full(5, 2.0 * r, np.float32) for r in range(4)]),
+        }
+        out = allreduce_persistent(stacked, naive)
+        np.testing.assert_allclose(out["mean"], np.full((4, 5), 1.5))
+        np.testing.assert_allclose(out["var"], np.full((4, 5), 3.0))
+
+    def test_xla_matches_naive(self, comm):
+        stacked = np.stack([np.full((2, 3), r, np.float32) for r in range(8)])
+        out = np.asarray(allreduce_persistent({"m": stacked}, comm)["m"])
+        np.testing.assert_allclose(out, np.full((8, 2, 3), 3.5))
+
+
+class TestObservationAggregator:
+    def test_scalar_mean_identity_single_controller(self, comm):
+        obs = {"loss": 2.5, "accuracy": 0.75}
+        out = aggregate_observations(obs, comm)
+        assert out["loss"] == pytest.approx(2.5)
+        assert out["accuracy"] == pytest.approx(0.75)
+
+
+class TestExceptHook:
+    def test_install_remove_and_passthrough(self):
+        orig = sys.excepthook
+        global_except_hook.add_hook()
+        assert sys.excepthook is not orig
+        global_except_hook.add_hook()  # idempotent
+        # Single process: delegates to the original hook (no abort).
+        try:
+            raise ValueError("boom")
+        except ValueError:
+            info = sys.exc_info()
+        global_except_hook._global_except_hook(*info)  # must not os._exit
+        global_except_hook.remove_hook()
+        assert sys.excepthook is orig

@@ -1,0 +1,146 @@
+"""Iterator tests.
+
+Reference parity: ``tests/iterators_tests/`` [uv] (SURVEY.md §4) — batch
+stream replication for the multi-node iterator, identical shuffle order for
+the synchronized iterator — plus the epoch/resume contract of our standalone
+SerialIterator.
+"""
+
+import numpy as np
+import pytest
+
+import chainermn_tpu as mn
+from chainermn_tpu.iterators import (
+    SerialIterator,
+    create_multi_node_iterator,
+    create_synchronized_iterator,
+)
+
+
+@pytest.fixture(scope="module")
+def comm(devices):
+    return mn.create_communicator("xla", devices=devices)
+
+
+def make_dataset(n=23):
+    return [(np.float32(i), np.int32(i % 3)) for i in range(n)]
+
+
+class TestSerialIterator:
+    def test_covers_epoch_without_shuffle(self):
+        ds = make_dataset(10)
+        it = SerialIterator(ds, 5, shuffle=False)
+        b1, b2 = it.next(), it.next()
+        assert [x[0] for x in b1] == [0, 1, 2, 3, 4]
+        assert [x[0] for x in b2] == [5, 6, 7, 8, 9]
+        assert it.epoch == 1 and it.is_new_epoch
+
+    def test_shuffle_covers_all(self):
+        ds = make_dataset(12)
+        it = SerialIterator(ds, 4, shuffle=True, seed=0)
+        seen = [x[0] for _ in range(3) for x in it.next()]
+        assert sorted(seen) == list(range(12))
+
+    def test_ragged_tail_padded_from_next_epoch(self):
+        ds = make_dataset(10)
+        it = SerialIterator(ds, 4, shuffle=False)
+        it.next()
+        it.next()
+        tail = it.next()
+        assert len(tail) == 4  # 2 leftovers + 2 from next epoch
+        assert it.epoch == 1
+        assert it.current_position == 2
+
+    def test_no_repeat_stops(self):
+        ds = make_dataset(6)
+        it = SerialIterator(ds, 4, repeat=False, shuffle=False)
+        assert len(it.next()) == 4
+        assert len(it.next()) == 2  # ragged tail, not padded
+        with pytest.raises(StopIteration):
+            it.next()
+
+    def test_epoch_detail(self):
+        ds = make_dataset(10)
+        it = SerialIterator(ds, 5, shuffle=False)
+        assert it.epoch_detail == 0.0
+        it.next()
+        assert it.epoch_detail == 0.5
+
+    def test_state_roundtrip_resumes_same_stream(self):
+        ds = make_dataset(20)
+        it = SerialIterator(ds, 3, shuffle=True, seed=7)
+        for _ in range(4):
+            it.next()
+        state = it.state_dict()
+        expect = [it.next() for _ in range(5)]
+        it2 = SerialIterator(ds, 3, shuffle=True, seed=123)  # different seed
+        it2.load_state_dict(state)
+        got = [it2.next() for _ in range(5)]
+        for a, b in zip(expect, got):
+            assert [x[0] for x in a] == [x[0] for x in b]
+
+    def test_reset(self):
+        ds = make_dataset(8)
+        it = SerialIterator(ds, 4, shuffle=True, seed=3)
+        first = [x[0] for x in it.next()]
+        it.next()
+        it.reset()
+        assert it.epoch == 0 and it.current_position == 0
+        assert [x[0] for x in it.next()] == first
+
+
+class TestMultiNodeIterator:
+    def test_replicates_master_stream(self, comm):
+        ds = make_dataset(12)
+        base = SerialIterator(ds, 4, shuffle=True, seed=1)
+        oracle = SerialIterator(ds, 4, shuffle=True, seed=1)
+        it = create_multi_node_iterator(base, comm, rank_master=0)
+        for _ in range(6):
+            batch = it.next()
+            assert [x[0] for x in batch] == [x[0] for x in oracle.next()]
+        assert it.epoch == base.epoch
+
+    def test_stop_iteration_propagates(self, comm):
+        ds = make_dataset(4)
+        it = create_multi_node_iterator(
+            SerialIterator(ds, 4, repeat=False, shuffle=False), comm)
+        it.next()
+        with pytest.raises(StopIteration):
+            it.next()
+
+
+class _FakeTwoProcessComm:
+    """Emulates the DCN bcast_obj across two controller processes: the first
+    caller plays root and its payload is returned to every later caller —
+    the single-process analog of mpiexec -n 2 for testing wrapper logic."""
+
+    def __init__(self):
+        self._root_payload = None
+
+    def bcast_obj(self, obj, root=0):
+        if self._root_payload is None:
+            self._root_payload = obj
+        import pickle
+        return pickle.loads(pickle.dumps(self._root_payload))
+
+
+class TestSynchronizedIterator:
+    def test_same_order_after_sync_across_processes(self):
+        ds = make_dataset(16)
+        fake = _FakeTwoProcessComm()
+        its = [
+            create_synchronized_iterator(
+                SerialIterator(ds, 4, shuffle=True, seed=seed), fake)
+            for seed in (11, 22)  # deliberately different seeds per "process"
+        ]
+        for _ in range(8):
+            batches = [[x[0] for x in it.next()] for it in its]
+            assert batches[0] == batches[1]
+
+    def test_single_process_passthrough(self, comm):
+        ds = make_dataset(16)
+        it = create_synchronized_iterator(
+            SerialIterator(ds, 4, shuffle=True, seed=5), comm)
+        oracle = SerialIterator(ds, 4, shuffle=True, seed=5)
+        # Single controller: sync leaves the master's own stream untouched.
+        assert [x[0] for x in it.next()] == [x[0] for x in oracle.next()]
