@@ -8,7 +8,7 @@ parallelism is sharding + ppermute instead of MPI send/recv.  No CUDA, NCCL
 or mpi4py anywhere in the import graph.
 """
 
-from . import extensions, functions, global_except_hook, iterators, links, ops  # noqa: F401
+from . import extensions, functions, global_except_hook, iterators, links, ops, training  # noqa: F401
 from .extensions import (  # noqa: F401
     AllreducePersistent,
     ObservationAggregator,

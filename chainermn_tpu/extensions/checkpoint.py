@@ -80,17 +80,20 @@ class MultiNodeCheckpointer:
     _PAT = re.compile(
         r"^(?P<name>.+)\.iter(?P<it>\d{12})\.proc(?P<proc>\d+)of(?P<nproc>\d+)$")
 
-    def _local_generations(self, any_world_size: bool = False) -> List[int]:
-        """Iterations for which THIS process has a shard on disk (matching
+    def _local_files(self, any_world_size: bool = False) -> List[Tuple[int, str]]:
+        """(iteration, filename) shards THIS process has on disk (matching
         the current world size unless ``any_world_size``)."""
-        gens = []
+        out = []
         for fn in os.listdir(self.path):
             m = self._PAT.match(fn)
             if (m and m.group("name") == self.name
                     and int(m.group("proc")) == self._process
                     and (any_world_size or int(m.group("nproc")) == self._nproc)):
-                gens.append(int(m.group("it")))
-        return sorted(gens)
+                out.append((int(m.group("it")), os.path.join(self.path, fn)))
+        return sorted(out)
+
+    def _local_generations(self, any_world_size: bool = False) -> List[int]:
+        return [it for it, _ in self._local_files(any_world_size)]
 
     # ---- save / load ----
     def save(self, state: Any, iteration: int) -> None:
@@ -139,20 +142,26 @@ class MultiNodeCheckpointer:
 
         Returns ``(state, iteration)``; ``(state, None)`` untouched when no
         consistent checkpoint exists (fresh start) — mirroring the
-        reference's ``maybe_load`` no-op contract [uv].  A restart with a
-        *different* world size fails loudly instead of silently dropping the
-        missing processes' shards (the reference required same rank count
-        [uv]; here it is enforced).
+        reference's ``maybe_load`` no-op contract [uv].  If shards exist but
+        NO generation is consistent across every process (world-size change,
+        or a save that crashed partway through the gang with nothing older
+        to fall back to), every process raises the same error — the decision
+        is taken on gang-agreed information so the job can never split into
+        crashed and fresh-started halves (the reference required same rank
+        count [uv]; here it is enforced, loudly and collectively).
         """
         gens = self._consistent_generations()
         if not gens:
-            stale = self._local_generations(any_world_size=True)
-            if stale:
+            any_stale = any(self.comm.allgather_obj(
+                bool(self._local_generations(any_world_size=True))))
+            if any_stale:
                 raise RuntimeError(
-                    f"checkpoints for '{self.name}' in {self.path} were saved "
-                    f"with a different world size than the current "
-                    f"{self._nproc} process(es); resume with the original "
-                    "world size or delete the stale shards")
+                    f"checkpoint shards for '{self.name}' exist in "
+                    f"{self.path} but no generation is consistent across "
+                    f"all {self._nproc} process(es) — the world size "
+                    "changed, or an interrupted save left only partial "
+                    "shards; resume with the original world size or delete "
+                    "the stale shards")
             return state, None
         it = gens[-1]
         with open(self._filename(it), "rb") as f:
@@ -164,17 +173,22 @@ class MultiNodeCheckpointer:
         return self._consistent_generations()
 
     def finalize(self) -> None:
-        """Delete every local shard (reference: cleanup on job teardown [uv])."""
-        for it in self._local_generations(any_world_size=True):
+        """Delete every local shard (reference: cleanup on job teardown [uv]),
+        including shards saved under a different world size."""
+        for _, path in self._local_files(any_world_size=True):
             try:
-                os.unlink(self._filename(it))
+                os.unlink(path)
             except FileNotFoundError:
                 pass
 
     # ---- trainer-extension face (chainermn_tpu.training) ----
+    # When registering directly (``trainer.extend(checkpointer)``) the save
+    # cadence comes from the TRAINER's trigger alone; ``cp_interval`` is only
+    # this extension's default trigger period, never a second gate.
+    trigger = property(lambda self: (self.cp_interval, "iteration"))
+
     def __call__(self, trainer) -> None:
-        if trainer.iteration % self.cp_interval == 0:
-            self.save(trainer.checkpoint_state(), trainer.iteration)
+        self.save(trainer.checkpoint_state(), trainer.iteration)
 
 
 def create_multi_node_checkpointer(

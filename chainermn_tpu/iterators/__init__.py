@@ -70,10 +70,16 @@ class SerialIterator:
             self.is_new_epoch = True
             self.current_position = 0
             self._order = self._new_order()
-            if self.repeat and len(batch) < self.batch_size:
-                pad = self.batch_size - len(batch)
-                batch.extend(self.dataset[int(j)] for j in self._order[:pad])
-                self.current_position = pad
+            if self.repeat:
+                # Pad from subsequent epoch(s) — looping so batch_size > n
+                # still yields full, fixed-shape batches (no recompiles).
+                while len(batch) < self.batch_size:
+                    take = min(self.batch_size - len(batch), n)
+                    batch.extend(self.dataset[int(j)] for j in self._order[:take])
+                    self.current_position = take % n
+                    if take == n:
+                        self.epoch += 1
+                        self._order = self._new_order()
         else:
             self.is_new_epoch = False
             self.current_position = stop

@@ -1,0 +1,34 @@
+"""Training loop with extension slots.
+
+The reference has NO trainer of its own — it plugs into Chainer's
+``Trainer``/``Updater``/``Extension`` machinery (SURVEY.md §1: "the
+'runtime' is Chainer's Trainer loop").  A standalone framework must ship
+that substrate, so this module provides the same architecture — an updater
+that advances one iteration, a trainer that fires prioritized extensions on
+interval triggers — built around jitted SPMD steps: the updater owns
+replicated train state and calls one compiled step per iteration; the
+device never syncs with the host unless an extension actually reads a
+value.
+
+Reference parity of the pieces (all [uv] against Chainer, the reference's
+substrate): ``training.Trainer``, ``training.updaters.StandardUpdater``,
+``training.triggers.IntervalTrigger``, extensions ``LogReport``,
+``PrintReport``, ``snapshot``; ChainerMN's own extensions
+(``chainermn/extensions/`` — SURVEY.md §2.6) slot in unchanged via
+``__call__(trainer)``.
+"""
+
+from .trainer import Trainer, Extension, make_extension  # noqa: F401
+from .triggers import IntervalTrigger, get_trigger  # noqa: F401
+from .updaters import StandardUpdater  # noqa: F401
+from . import extensions  # noqa: F401
+
+__all__ = [
+    "Trainer",
+    "Extension",
+    "make_extension",
+    "IntervalTrigger",
+    "get_trigger",
+    "StandardUpdater",
+    "extensions",
+]

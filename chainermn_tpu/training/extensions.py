@@ -1,0 +1,140 @@
+"""Stock trainer extensions: LogReport, PrintReport, Evaluator, snapshot.
+
+Chainer analogs [uv] (`training/extensions/` in the reference's substrate);
+rank-0 gating mirrors how the reference's examples register reporting
+extensions only ``if comm.rank == 0`` (SURVEY.md §5 "metrics/logging").
+Device scalars in observations are synced exactly once per log write —
+the only host↔device sync points in the loop.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from .trainer import PRIORITY_READER, PRIORITY_WRITER
+
+
+def _scalarize(v) -> float:
+    return float(np.asarray(jax.device_get(v)))
+
+
+class LogReport:
+    """Accumulate observations; write mean entries every trigger.
+
+    Entries land in ``trainer.out/log`` (JSON list, Chainer-compatible
+    layout [uv]) and stay available in ``.log`` for PrintReport.
+    """
+
+    trigger = (1, "epoch")
+    priority = PRIORITY_WRITER
+
+    def __init__(self, keys: Optional[Sequence[str]] = None,
+                 trigger=(1, "epoch"), filename: str = "log"):
+        self.keys = keys
+        self.trigger = trigger
+        self.filename = filename
+        self.log: List[Dict[str, Any]] = []
+        self._accum: Dict[str, List[float]] = {}
+        self._count = 0
+
+    def initialize(self, trainer) -> None:
+        os.makedirs(trainer.out, exist_ok=True)
+
+    def _accumulate(self, observation) -> None:
+        for k, v in observation.items():
+            if self.keys is not None and k not in self.keys:
+                continue
+            try:
+                self._accum.setdefault(k, []).append(_scalarize(v))
+            except (TypeError, ValueError):
+                pass  # non-scalar observation; LogReport only handles scalars
+        self._count += 1
+
+    def observe(self, trainer) -> None:
+        # Trainer calls this every iteration: fold the step's observation
+        # into the running means regardless of when the write trigger fires.
+        self._accumulate(trainer.observation)
+
+    def __call__(self, trainer) -> None:
+        entry = {k: float(np.mean(vs)) for k, vs in self._accum.items()}
+        entry.update({
+            "iteration": trainer.iteration,
+            "epoch": trainer.epoch,
+            "elapsed_time": trainer.elapsed_time,
+        })
+        self.log.append(entry)
+        self._accum, self._count = {}, 0
+        with open(os.path.join(trainer.out, self.filename), "w") as f:
+            json.dump(self.log, f, indent=2)
+
+    def state_dict(self) -> dict:
+        return {"log": self.log}
+
+    def load_state_dict(self, state: dict) -> None:
+        self.log = list(state["log"])
+
+
+class PrintReport:
+    """Print selected LogReport columns as they appear (rank-0 style)."""
+
+    trigger = (1, "epoch")
+    priority = PRIORITY_READER
+
+    def __init__(self, entries: Sequence[str], log_report: LogReport,
+                 trigger=(1, "epoch")):
+        self.entries = list(entries)
+        self.log_report = log_report
+        self.trigger = trigger
+        self._printed = 0
+        self._header_done = False
+
+    def __call__(self, trainer) -> None:
+        if not self._header_done:
+            print("  ".join(f"{e:>14}" for e in self.entries), flush=True)
+            self._header_done = True
+        for entry in self.log_report.log[self._printed:]:
+            cells = []
+            for e in self.entries:
+                v = entry.get(e, "")
+                cells.append(f"{v:14.6g}" if isinstance(v, float) else f"{v!s:>14}")
+            print("  ".join(cells), flush=True)
+        self._printed = len(self.log_report.log)
+
+
+class EvaluatorExtension:
+    """Run a multi-node evaluator on a trigger, merging results into the
+    observation under ``validation/`` keys (Chainer ``Evaluator`` slot [uv])."""
+
+    trigger = (1, "epoch")
+    priority = PRIORITY_WRITER + 50  # before LogReport writes the entry
+
+    def __init__(self, evaluate_fn: Callable[[Any], Dict[str, float]],
+                 data, trigger=(1, "epoch"), prefix: str = "validation/"):
+        self.evaluate_fn = evaluate_fn
+        self.data = data
+        self.trigger = trigger
+        self.prefix = prefix
+
+    def __call__(self, trainer) -> None:
+        results = self.evaluate_fn(self.data)
+        trainer.observation.update(
+            {f"{self.prefix}{k}": v for k, v in results.items()})
+
+
+def snapshot(checkpointer, trigger=None):
+    """Adapt a MultiNodeCheckpointer into a trainer extension (the
+    reference's ``trainer.extend(checkpointer, trigger=...)`` usage [uv])."""
+    from .trainer import make_extension
+
+    trig = trigger or (checkpointer.cp_interval, "iteration")
+
+    @make_extension(trigger=trig, priority=PRIORITY_WRITER,
+                    name="multi_node_snapshot")
+    def _snap(trainer):
+        checkpointer.save(trainer.checkpoint_state(), trainer.iteration)
+    return _snap

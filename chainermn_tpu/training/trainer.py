@@ -1,0 +1,156 @@
+"""The Trainer: run the updater until a stop trigger, firing extensions.
+
+Chainer-Trainer analog [uv] (the reference's runtime substrate, SURVEY.md
+§1/§3.2).  Extensions are callables ``ext(trainer)`` registered with an
+interval trigger and a priority; higher priority runs first within an
+iteration so aggregators (ObservationAggregator) run before writers
+(LogReport) before readers (PrintReport) — the same three-band scheme
+Chainer used (PRIORITY_WRITER/EDITOR/READER [uv]).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, Optional
+
+from .triggers import get_trigger
+
+PRIORITY_EDITOR = 300   # mutate trainer.observation (aggregators)
+PRIORITY_WRITER = 200   # persist observations (LogReport, snapshots)
+PRIORITY_READER = 100   # consume logs (PrintReport)
+
+
+class Extension:
+    """Optional base class; any callable(trainer) works."""
+
+    trigger = (1, "iteration")
+    priority = PRIORITY_READER
+    name: Optional[str] = None
+
+    def __call__(self, trainer) -> None:
+        raise NotImplementedError
+
+    def initialize(self, trainer) -> None:
+        pass
+
+    def finalize(self) -> None:
+        pass
+
+
+def make_extension(trigger=(1, "iteration"), priority=PRIORITY_READER,
+                   name=None):
+    """Decorator stamping trigger/priority onto a plain function."""
+    def wrap(fn):
+        fn.trigger = trigger
+        fn.priority = priority
+        fn.name = name or fn.__name__
+        return fn
+    return wrap
+
+
+class _Entry:
+    def __init__(self, ext, trigger, priority, name):
+        self.extension = ext
+        self.trigger = get_trigger(trigger)
+        self.priority = priority
+        self.name = name
+
+
+class Trainer:
+    """Drive ``updater.update()`` until ``stop_trigger``; fire extensions."""
+
+    def __init__(self, updater, stop_trigger, out: str = "result"):
+        self.updater = updater
+        period, unit = stop_trigger
+        self._stop_period, self._stop_unit = period, unit
+        self.out = out
+        self.observation: Dict[str, Any] = {}
+        self._extensions: Dict[str, _Entry] = {}
+        self._start_time: Optional[float] = None
+
+    # ---- passthroughs the extensions read ----
+    @property
+    def iteration(self) -> int:
+        return self.updater.iteration
+
+    @property
+    def epoch(self) -> int:
+        return self.updater.epoch
+
+    @property
+    def epoch_detail(self) -> float:
+        return self.updater.epoch_detail
+
+    @property
+    def is_new_epoch(self) -> bool:
+        return self.updater.is_new_epoch
+
+    @property
+    def elapsed_time(self) -> float:
+        return 0.0 if self._start_time is None else time.time() - self._start_time
+
+    # ---- extension registry ----
+    def extend(self, extension: Callable, trigger=None, priority=None,
+               name: Optional[str] = None) -> None:
+        trigger = trigger if trigger is not None else getattr(
+            extension, "trigger", (1, "iteration"))
+        priority = priority if priority is not None else getattr(
+            extension, "priority", PRIORITY_READER)
+        name = name or getattr(extension, "name", None) \
+            or type(extension).__name__
+        base, i = name, 0
+        while name in self._extensions:
+            i += 1
+            name = f"{base}_{i}"
+        self._extensions[name] = _Entry(extension, trigger, priority, name)
+
+    def get_extension(self, name: str):
+        return self._extensions[name].extension
+
+    # ---- the loop ----
+    def _stopped(self) -> bool:
+        if self._stop_unit == "iteration":
+            return self.iteration >= self._stop_period
+        return self.epoch >= self._stop_period
+
+    def run(self) -> None:
+        self._start_time = time.time()
+        for e in self._extensions.values():
+            if hasattr(e.extension, "initialize"):
+                e.extension.initialize(self)
+        try:
+            while not self._stopped():
+                self.observation = self.updater.update()
+                for e in sorted(self._extensions.values(),
+                                key=lambda e: -e.priority):
+                    # Extensions with an ``observe`` hook see EVERY iteration
+                    # (e.g. LogReport folding per-step stats into its means);
+                    # ``__call__`` still fires only on the trigger — the same
+                    # split Chainer's reporter/summary machinery provided [uv].
+                    if hasattr(e.extension, "observe"):
+                        e.extension.observe(self)
+                    if e.trigger(self):
+                        e.extension(self)
+        finally:
+            for e in self._extensions.values():
+                if hasattr(e.extension, "finalize"):
+                    e.extension.finalize()
+
+    # ---- resume contract (MultiNodeCheckpointer calls checkpoint_state) ----
+    def checkpoint_state(self) -> dict:
+        state = {"updater": self.updater.state_dict(), "extensions": {}}
+        for name, e in self._extensions.items():
+            if hasattr(e.extension, "state_dict"):
+                state["extensions"][name] = e.extension.state_dict()
+            if hasattr(e.trigger, "state_dict"):
+                state["extensions"][f"{name}/trigger"] = e.trigger.state_dict()
+        return state
+
+    def load_checkpoint_state(self, state: dict) -> None:
+        self.updater.load_state_dict(state["updater"])
+        for name, e in self._extensions.items():
+            if name in state["extensions"] and hasattr(e.extension, "load_state_dict"):
+                e.extension.load_state_dict(state["extensions"][name])
+            tkey = f"{name}/trigger"
+            if tkey in state["extensions"] and hasattr(e.trigger, "load_state_dict"):
+                e.trigger.load_state_dict(state["extensions"][tkey])

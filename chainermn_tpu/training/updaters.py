@@ -1,0 +1,94 @@
+"""Updaters: advance training by one iteration.
+
+Chainer's ``StandardUpdater`` (the loop body under the reference's hot path,
+SURVEY.md §3.2 "trainer.run → StandardUpdater.update_core") pulled a batch,
+ran forward/backward eagerly, then the optimizer.  TPU-native the whole
+iteration is one pre-compiled SPMD step: the updater converts the host
+batch, shards it over the mesh, and calls the jitted step — device work is
+dispatched asynchronously, so back-to-back iterations pipeline on-device
+while the host prepares the next batch.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import numpy as np
+
+from ..train import shard_batch
+
+
+def default_converter(batch):
+    """List of (x, y, ...) tuples → tuple of stacked arrays."""
+    if isinstance(batch[0], tuple):
+        n = len(batch[0])
+        return tuple(np.stack([b[i] for b in batch]) for i in range(n))
+    return np.stack(batch)
+
+
+class StandardUpdater:
+    """Owns train state + iterator; one ``update()`` = one jitted step.
+
+    ``step_fn(state, batch) -> (state, observation_dict)`` where ``state``
+    is an arbitrary replicated pytree (params/opt_state/batch_stats...).
+    ``observation`` values may be device scalars; they are NOT synced here
+    (extensions decide when to block on them).
+    """
+
+    def __init__(self, iterator, step_fn: Callable, state: Any,
+                 converter: Callable = default_converter,
+                 mesh=None, axis_name: Optional[str] = None,
+                 shard: bool = True):
+        self.iterator = iterator
+        self.step_fn = step_fn
+        self.state = state
+        self.converter = converter
+        self.mesh = mesh
+        self.axis_name = axis_name
+        self.shard = shard
+        self.iteration = 0
+
+    @property
+    def epoch(self) -> int:
+        return getattr(self.iterator, "epoch", 0)
+
+    @property
+    def is_new_epoch(self) -> bool:
+        return getattr(self.iterator, "is_new_epoch", False)
+
+    @property
+    def epoch_detail(self) -> float:
+        return getattr(self.iterator, "epoch_detail", float(self.epoch))
+
+    def update(self) -> Dict[str, Any]:
+        batch = self.iterator.next()
+        arrays = self.converter(batch)
+        if self.shard:
+            kwargs = {}
+            if self.mesh is not None:
+                kwargs["mesh"] = self.mesh
+            if self.axis_name is not None:
+                kwargs["axis_name"] = self.axis_name
+            arrays = shard_batch(arrays, **kwargs)
+        self.state, observation = self.step_fn(self.state, arrays)
+        self.iteration += 1
+        return dict(observation)
+
+    # ---- resume contract ----
+    def state_dict(self) -> dict:
+        out = {"iteration": self.iteration, "state": self.state}
+        if hasattr(self.iterator, "state_dict"):
+            out["iterator"] = self.iterator.state_dict()
+        return out
+
+    def load_state_dict(self, state: dict) -> None:
+        self.iteration = int(state["iteration"])
+        loaded = state["state"]
+        # Restore device placement by matching the template's sharding.
+        self.state = jax.tree_util.tree_map(
+            lambda tmpl, v: jax.device_put(v, tmpl.sharding)
+            if isinstance(tmpl, jax.Array) else v,
+            self.state, loaded)
+        if "iterator" in state and hasattr(self.iterator, "load_state_dict"):
+            self.iterator.load_state_dict(state["iterator"])

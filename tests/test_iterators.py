@@ -144,3 +144,13 @@ class TestSynchronizedIterator:
         oracle = SerialIterator(ds, 4, shuffle=True, seed=5)
         # Single controller: sync leaves the master's own stream untouched.
         assert [x[0] for x in it.next()] == [x[0] for x in oracle.next()]
+
+
+class TestSerialIteratorSmallDataset:
+    def test_batch_larger_than_dataset_keeps_shape(self):
+        ds = make_dataset(4)
+        it = SerialIterator(ds, 10, shuffle=False)
+        for _ in range(5):
+            assert len(it.next()) == 10  # fixed shape, no recompiles
+        assert 0 <= it.current_position < 4
+        assert it.epoch >= 5  # 10 items per batch over 4-item dataset
