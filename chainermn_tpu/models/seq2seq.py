@@ -1,0 +1,181 @@
+"""Encoder–decoder seq2seq (the reference's translation workload).
+
+Reference parity: ``examples/seq2seq/seq2seq.py`` [uv] (SURVEY.md §2.9,
+BASELINE config #3) — an embed → stacked-LSTM encoder → stacked-LSTM
+decoder → projection network trained with teacher forcing on padded
+variable-length pairs.
+
+TPU-first design: the reference used Chainer's ``NStepLSTM`` over *lists*
+of variable-length CuPy arrays (cuDNN packed sequences).  Dynamic shapes
+would defeat XLA, so here sequences are right-padded to a static bucket
+length and time recurrence is ``flax.linen.scan`` over the time axis —
+one compiled program per bucket shape, MXU-friendly batched matmuls at
+every step, and a mask carries the ragged lengths.  PAD=0 never
+contributes to loss and never advances encoder state.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+PAD, BOS, EOS = 0, 1, 2
+N_SPECIAL = 3
+
+
+class _EncoderStep(nn.Module):
+    """One masked time-step through stacked LSTM cells: pad positions
+    (mask 0) freeze both c and h so the final carry is the state at each
+    sequence's last real token."""
+
+    hidden: int
+    n_layers: int
+
+    @nn.compact
+    def __call__(self, carry, xs):
+        x, m = xs  # (B, units), (B, 1)
+        new_carry = []
+        inp = x
+        for i in range(self.n_layers):
+            cell = nn.OptimizedLSTMCell(self.hidden, name=f"lstm{i}")
+            (c_new, h_new), inp = cell(carry[i], inp)
+            c_old, h_old = carry[i]
+            new_carry.append((m * c_new + (1 - m) * c_old,
+                              m * h_new + (1 - m) * h_old))
+        return tuple(new_carry), inp
+
+
+class _DecoderStep(nn.Module):
+    """One time-step through stacked LSTM cells (no mask: teacher forcing
+    loss masks pad positions instead)."""
+
+    hidden: int
+    n_layers: int
+
+    @nn.compact
+    def __call__(self, carry, x):
+        new_carry = []
+        inp = x
+        for i in range(self.n_layers):
+            cell = nn.OptimizedLSTMCell(self.hidden, name=f"lstm{i}")
+            c, inp = cell(carry[i], inp)
+            new_carry.append(c)
+        return tuple(new_carry), inp
+
+
+def _scan_over_time(step_cls, *args, name):
+    return nn.scan(
+        step_cls,
+        variable_broadcast="params",
+        split_rngs={"params": False},
+        in_axes=1, out_axes=1)(*args, name=name)
+
+
+class Seq2seq(nn.Module):
+    """Embed → LSTM encode → LSTM decode (teacher forcing) → logits.
+
+    ``__call__(src, tgt_in)`` returns per-position target logits; ``src``
+    and ``tgt_in`` are int32 ``(batch, time)`` right-padded with PAD.
+    """
+
+    n_source_vocab: int
+    n_target_vocab: int
+    n_units: int = 512
+    n_layers: int = 3
+    dtype: Any = jnp.bfloat16  # MXU-native compute; params stay fp32
+
+    def setup(self):
+        self.embed_x = nn.Embed(self.n_source_vocab, self.n_units,
+                                dtype=self.dtype)
+        self.embed_y = nn.Embed(self.n_target_vocab, self.n_units,
+                                dtype=self.dtype)
+        self.encoder = _scan_over_time(
+            _EncoderStep, self.n_units, self.n_layers, name="encoder")
+        self.decoder = _scan_over_time(
+            _DecoderStep, self.n_units, self.n_layers, name="decoder")
+        self.proj = nn.Dense(self.n_target_vocab, dtype=self.dtype)
+
+    def _init_carry_like(self, emb: jnp.ndarray):
+        # Derive zeros from the embeddings rather than jnp.zeros so the
+        # carry inherits their sharding/varying-axis type — required for
+        # lax.scan type agreement inside shard_map'ped training steps.
+        zeros = emb[:, 0, :] * 0
+        return tuple((zeros, zeros) for _ in range(self.n_layers))
+
+    def encode(self, src: jnp.ndarray):
+        """Final stacked-LSTM carry at each sequence's last real token."""
+        mask = (src != PAD)[..., None].astype(self.dtype)  # (B, T, 1)
+        emb = self.embed_x(src) * mask
+        carry, _ = self.encoder(self._init_carry_like(emb), (emb, mask))
+        return carry
+
+    def __call__(self, src: jnp.ndarray, tgt_in: jnp.ndarray) -> jnp.ndarray:
+        carry = self.encode(src)
+        emb = self.embed_y(tgt_in)
+        _, hs = self.decoder(carry, emb)
+        return self.proj(hs).astype(jnp.float32)
+
+    def translate(self, src: jnp.ndarray, max_len: int = 32) -> jnp.ndarray:
+        """Greedy decode under jit: fixed ``max_len`` steps of ``lax.scan``
+        (static shapes — a data-dependent while_loop would defeat batching),
+        with EOS-frozen emission (reference: ``Seq2seq.translate`` eager
+        per-sentence loop [uv])."""
+        batch = src.shape[0]
+        carry = self.encode(src)
+        bos = jnp.full((batch,), BOS, jnp.int32)
+
+        def step(state, _):
+            carry, tok, done = state
+            emb = self.embed_y(tok[:, None])
+            carry, h = self.decoder(carry, emb)
+            nxt = self.proj(h[:, 0]).astype(jnp.float32).argmax(-1).astype(jnp.int32)
+            nxt = jnp.where(done, PAD, nxt)
+            done = done | (nxt == EOS)
+            return (carry, nxt, done), nxt
+
+        _, toks = jax.lax.scan(
+            step, (carry, bos, jnp.zeros((batch,), bool)), None, length=max_len)
+        return jnp.swapaxes(toks, 0, 1)  # (B, max_len)
+
+
+def masked_cross_entropy(logits: jnp.ndarray, tgt_out: jnp.ndarray) -> jnp.ndarray:
+    """Mean NLL over non-PAD target positions (per-token, so loss scale is
+    independent of padding/bucketing)."""
+    logp = jax.nn.log_softmax(logits)
+    nll = -jnp.take_along_axis(logp, tgt_out[..., None], axis=-1)[..., 0]
+    mask = (tgt_out != PAD).astype(nll.dtype)
+    return (nll * mask).sum() / jnp.maximum(mask.sum(), 1)
+
+
+def token_accuracy(logits: jnp.ndarray, tgt_out: jnp.ndarray) -> jnp.ndarray:
+    mask = tgt_out != PAD
+    hit = (logits.argmax(-1) == tgt_out) & mask
+    return hit.sum() / jnp.maximum(mask.sum(), 1)
+
+
+# ---- host-side data plumbing (padding / bucketing; reference fed lists) ----
+
+def encode_pairs(pairs: Sequence[Tuple[Sequence[int], Sequence[int]]],
+                 src_len: int, tgt_len: int):
+    """Pad (src_ids, tgt_ids) token pairs into fixed-shape int32 arrays:
+    ``src (N, src_len)``, ``tgt_in (N, tgt_len)`` (BOS-prefixed), ``tgt_out
+    (N, tgt_len)`` (EOS-suffixed) — the static-shape stand-in for the
+    reference's variable-length list feed."""
+    import numpy as np
+
+    n = len(pairs)
+    src = np.full((n, src_len), PAD, np.int32)
+    tgt_in = np.full((n, tgt_len), PAD, np.int32)
+    tgt_out = np.full((n, tgt_len), PAD, np.int32)
+    for i, (s, t) in enumerate(pairs):
+        s = list(s)[:src_len]
+        t = list(t)[: tgt_len - 1]
+        src[i, : len(s)] = s
+        tgt_in[i, 0] = BOS
+        tgt_in[i, 1 : len(t) + 1] = t
+        tgt_out[i, : len(t)] = t
+        tgt_out[i, len(t)] = EOS
+    return src, tgt_in, tgt_out

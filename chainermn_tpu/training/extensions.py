@@ -40,7 +40,6 @@ class LogReport:
         self.filename = filename
         self.log: List[Dict[str, Any]] = []
         self._accum: Dict[str, List[float]] = {}
-        self._count = 0
 
     def initialize(self, trainer) -> None:
         os.makedirs(trainer.out, exist_ok=True)
@@ -53,7 +52,6 @@ class LogReport:
                 self._accum.setdefault(k, []).append(_scalarize(v))
             except (TypeError, ValueError):
                 pass  # non-scalar observation; LogReport only handles scalars
-        self._count += 1
 
     def observe(self, trainer) -> None:
         # Trainer calls this every iteration: fold the step's observation
@@ -68,15 +66,19 @@ class LogReport:
             "elapsed_time": trainer.elapsed_time,
         })
         self.log.append(entry)
-        self._accum, self._count = {}, 0
+        self._accum = {}
         with open(os.path.join(trainer.out, self.filename), "w") as f:
             json.dump(self.log, f, indent=2)
 
     def state_dict(self) -> dict:
-        return {"log": self.log}
+        # In-flight accumulators are part of the resume contract: a
+        # mid-epoch checkpoint must reproduce the same epoch means as an
+        # uninterrupted run.
+        return {"log": self.log, "accum": self._accum}
 
     def load_state_dict(self, state: dict) -> None:
         self.log = list(state["log"])
+        self._accum = {k: list(v) for k, v in state.get("accum", {}).items()}
 
 
 class PrintReport:
@@ -92,6 +94,14 @@ class PrintReport:
         self.trigger = trigger
         self._printed = 0
         self._header_done = False
+
+    def state_dict(self) -> dict:
+        # Resume without re-printing the restored history.
+        return {"printed": self._printed, "header_done": self._header_done}
+
+    def load_state_dict(self, state: dict) -> None:
+        self._printed = int(state["printed"])
+        self._header_done = bool(state["header_done"])
 
     def __call__(self, trainer) -> None:
         if not self._header_done:
@@ -128,13 +138,19 @@ class EvaluatorExtension:
 
 def snapshot(checkpointer, trigger=None):
     """Adapt a MultiNodeCheckpointer into a trainer extension (the
-    reference's ``trainer.extend(checkpointer, trigger=...)`` usage [uv])."""
+    reference's ``trainer.extend(checkpointer, trigger=...)`` usage [uv]).
+
+    Thin wrapper over the checkpointer's own extension ``__call__`` (single
+    save path) whose only job is overriding the trigger and shielding the
+    trainer from the checkpointer's ``finalize`` (which deletes shards —
+    cleanup belongs to explicit job teardown, not loop exit).
+    """
     from .trainer import make_extension
 
-    trig = trigger or (checkpointer.cp_interval, "iteration")
+    trig = trigger or checkpointer.trigger
 
     @make_extension(trigger=trig, priority=PRIORITY_WRITER,
                     name="multi_node_snapshot")
     def _snap(trainer):
-        checkpointer.save(trainer.checkpoint_state(), trainer.iteration)
+        checkpointer(trainer)
     return _snap

@@ -114,31 +114,36 @@ class Trainer:
         return self.epoch >= self._stop_period
 
     def run(self) -> None:
-        self._start_time = time.time()
+        if self._start_time is None:  # a resumed trainer keeps its offset
+            self._start_time = time.time()
         for e in self._extensions.values():
             if hasattr(e.extension, "initialize"):
                 e.extension.initialize(self)
-        try:
-            while not self._stopped():
-                self.observation = self.updater.update()
-                for e in sorted(self._extensions.values(),
-                                key=lambda e: -e.priority):
-                    # Extensions with an ``observe`` hook see EVERY iteration
-                    # (e.g. LogReport folding per-step stats into its means);
-                    # ``__call__`` still fires only on the trigger — the same
-                    # split Chainer's reporter/summary machinery provided [uv].
-                    if hasattr(e.extension, "observe"):
-                        e.extension.observe(self)
-                    if e.trigger(self):
-                        e.extension(self)
-        finally:
-            for e in self._extensions.values():
-                if hasattr(e.extension, "finalize"):
-                    e.extension.finalize()
+        while not self._stopped():
+            self.observation = self.updater.update()
+            for e in sorted(self._extensions.values(),
+                            key=lambda e: -e.priority):
+                # Extensions with an ``observe`` hook see EVERY iteration
+                # (e.g. LogReport folding per-step stats into its means);
+                # ``__call__`` still fires only on the trigger — the same
+                # split Chainer's reporter/summary machinery provided [uv].
+                if hasattr(e.extension, "observe"):
+                    e.extension.observe(self)
+                if e.trigger(self):
+                    e.extension(self)
+        # Finalize ONLY on clean completion (divergence from Chainer's
+        # finally-block [uv], deliberately): extensions like the
+        # checkpointer delete their fault-tolerance artifacts in finalize,
+        # and doing that on the exception path would destroy exactly the
+        # state a crashed job needs to resume from.
+        for e in self._extensions.values():
+            if hasattr(e.extension, "finalize"):
+                e.extension.finalize()
 
     # ---- resume contract (MultiNodeCheckpointer calls checkpoint_state) ----
     def checkpoint_state(self) -> dict:
-        state = {"updater": self.updater.state_dict(), "extensions": {}}
+        state = {"updater": self.updater.state_dict(), "extensions": {},
+                 "elapsed_time": self.elapsed_time}
         for name, e in self._extensions.items():
             if hasattr(e.extension, "state_dict"):
                 state["extensions"][name] = e.extension.state_dict()
@@ -148,6 +153,8 @@ class Trainer:
 
     def load_checkpoint_state(self, state: dict) -> None:
         self.updater.load_state_dict(state["updater"])
+        # Keep elapsed_time monotonic across the resume boundary.
+        self._start_time = time.time() - float(state.get("elapsed_time", 0.0))
         for name, e in self._extensions.items():
             if name in state["extensions"] and hasattr(e.extension, "load_state_dict"):
                 e.extension.load_state_dict(state["extensions"][name])

@@ -16,7 +16,6 @@ from typing import Any, Callable, Dict, Optional
 import jax
 import numpy as np
 
-from ..train import shard_batch
 
 
 def default_converter(batch):
@@ -44,10 +43,19 @@ class StandardUpdater:
         self.step_fn = step_fn
         self.state = state
         self.converter = converter
-        self.mesh = mesh
-        self.axis_name = axis_name
         self.shard = shard
         self.iteration = 0
+        if shard:
+            # Resolve mesh + sharding ONCE: rebuilding them per step would
+            # put host-side Mesh construction on the hot path.
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            from ..topology import DEFAULT_AXIS_NAME, make_mesh
+            ax = axis_name or DEFAULT_AXIS_NAME
+            self.mesh = mesh if mesh is not None else make_mesh(axis_name=ax)
+            self._batch_sharding = NamedSharding(
+                self.mesh, P(self.mesh.axis_names[0]))
+        else:
+            self.mesh = mesh
 
     @property
     def epoch(self) -> int:
@@ -65,12 +73,8 @@ class StandardUpdater:
         batch = self.iterator.next()
         arrays = self.converter(batch)
         if self.shard:
-            kwargs = {}
-            if self.mesh is not None:
-                kwargs["mesh"] = self.mesh
-            if self.axis_name is not None:
-                kwargs["axis_name"] = self.axis_name
-            arrays = shard_batch(arrays, **kwargs)
+            arrays = jax.tree_util.tree_map(
+                lambda x: jax.device_put(x, self._batch_sharding), arrays)
         self.state, observation = self.step_fn(self.state, arrays)
         self.iteration += 1
         return dict(observation)
