@@ -8,7 +8,13 @@ parallelism is sharding + ppermute instead of MPI send/recv.  No CUDA, NCCL
 or mpi4py anywhere in the import graph.
 """
 
-from . import extensions, functions, global_except_hook, iterators, links, ops, training  # noqa: F401
+from . import extensions, functions, global_except_hook, iterators, links, ops, parallel, training  # noqa: F401
+from .parallel import (  # noqa: F401
+    make_ring_attention,
+    make_ulysses_attention,
+    ring_attention,
+    ulysses_attention,
+)
 from .extensions import (  # noqa: F401
     AllreducePersistent,
     ObservationAggregator,

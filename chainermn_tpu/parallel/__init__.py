@@ -1,0 +1,26 @@
+"""Sequence/context parallelism for long-context attention.
+
+The reference predates long-context techniques entirely (SURVEY.md §5
+"long-context: absent — 2017-era codebase"), but its L1/L3 primitives
+(`alltoall`, ring `send/recv`) are exactly the substrate they need; per the
+rebuild brief these are FIRST-CLASS here, built the TPU way: ring attention
+as a ``ppermute`` ring over ICI neighbors (the physical torus topology) with
+online-softmax accumulation, and Ulysses-style head↔sequence swaps as one
+XLA ``all_to_all``.
+"""
+
+from .ring_attention import (  # noqa: F401
+    make_ring_attention,
+    ring_attention,
+)
+from .ulysses import (  # noqa: F401
+    make_ulysses_attention,
+    ulysses_attention,
+)
+
+__all__ = [
+    "ring_attention",
+    "make_ring_attention",
+    "ulysses_attention",
+    "make_ulysses_attention",
+]

@@ -1,0 +1,38 @@
+"""Shared eager/jit factory for sequence-parallel attention kernels."""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Optional
+
+import jax
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def make_sp_attention(kernel: Callable, mesh: Optional[Mesh],
+                      axis_name: Optional[str], causal: bool):
+    """Wrap an inside-shard_map attention kernel ``kernel(q, k, v,
+    axis_name=..., causal=...)`` into ``fn(q, k, v)`` over GLOBAL
+    ``(B, S, H, D)`` arrays sequence-sharded over the mesh axis; compiles
+    once per shape."""
+    from ..topology import DEFAULT_AXIS_NAME, make_mesh
+
+    if mesh is None:
+        mesh = make_mesh(axis_name=axis_name or DEFAULT_AXIS_NAME)
+    ax = axis_name or mesh.axis_names[0]
+    spec = P(None, ax)  # shard the sequence axis
+
+    fn = shard_map(
+        partial(kernel, axis_name=ax, causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    jitted = jax.jit(fn)
+    sharding = NamedSharding(mesh, spec)
+
+    def apply(q, k, v):
+        q, k, v = (jax.device_put(x, sharding) for x in (q, k, v))
+        return jitted(q, k, v)
+
+    return apply

@@ -1,0 +1,107 @@
+"""Ring attention: exact attention over sequence shards on the ICI ring.
+
+Technique: blockwise attention with online softmax (Liu et al., "Ring
+Attention with Blockwise Transformers"; the reference has no analog —
+SURVEY.md §5 long-context entry).  Each device holds a sequence shard of
+Q/K/V; K/V blocks rotate around the mesh axis via ``jax.lax.ppermute``
+(nearest-neighbor on the TPU torus, so every hop is one ICI link, cost
+independent of world size) while each device folds the visiting block into
+its online-softmax accumulators.  Communication overlaps compute: XLA
+schedules step ``t``'s ppermute concurrently with step ``t``'s matmuls
+since they have no data dependence.
+
+Numerics: accumulation in fp32 regardless of input dtype (bf16 inputs stay
+bf16 through the matmuls — MXU-native — but m/l/o run fp32), the standard
+stabilized-softmax recurrence.  Exactness: results match full attention to
+dtype tolerance because online softmax is algebraically exact, not an
+approximation.
+
+Autodiff: the whole ring is a differentiable ``lax.scan`` whose transpose
+reverses the permutes (ppermute's transpose is the inverse permutation), so
+``jax.grad`` through ``ring_attention`` yields the exact backward ring —
+the autograd-crosses-ranks property the reference engineered by hand with
+Send/Recv FunctionNodes (SURVEY.md §3.5) falls out of XLA here.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from ._factory import NEG_INF as _NEG_INF, make_sp_attention
+
+
+def _block_scores(q, k, scale):
+    # (B, Sq, H, D) x (B, Sk, H, D) -> (B, H, Sq, Sk); fp32 accumulation on
+    # the MXU via preferred_element_type so bf16 inputs don't lose the
+    # softmax numerics.
+    return jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k,
+        preferred_element_type=jnp.float32) * scale
+
+
+def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                   axis_name: str, causal: bool = False) -> jnp.ndarray:
+    """Exact multi-head attention over a sequence-sharded axis.
+
+    Call INSIDE ``shard_map``: ``q,k,v`` are the local shards, shape
+    ``(batch, seq_local, heads, head_dim)``; the global sequence is
+    ``seq_local * axis_size`` in rank order along ``axis_name``.  Returns
+    the local output shard, same shape/dtype as ``q``.
+    """
+    p_size = jax.lax.psum(1, axis_name)
+    my = jax.lax.axis_index(axis_name)
+    b, s_q, h, d = q.shape
+    s_k = k.shape[1]
+    scale = 1.0 / (d ** 0.5)
+
+    q_pos = my * s_q + jnp.arange(s_q)  # global query positions
+
+    def step(carry, t):
+        k_blk, v_blk, m, l, o = carry
+        src = (my - t) % p_size  # who this block originally belonged to
+        s = _block_scores(q, k_blk, scale)  # (B, H, Sq, Sk) fp32
+        if causal:
+            k_pos = src * s_k + jnp.arange(s_k)
+            mask = q_pos[:, None] >= k_pos[None, :]  # (Sq, Sk)
+            s = jnp.where(mask[None, None], s, _NEG_INF)
+            pmask = mask[None, None].astype(s.dtype)
+        else:
+            pmask = 1.0
+        m_new = jnp.maximum(m, s.max(-1))                     # (B, H, Sq)
+        p = jnp.exp(s - m_new[..., None]) * pmask             # masked exact 0
+        corr = jnp.exp(m - m_new)
+        l = l * corr + p.sum(-1)
+        # PV matmul in the input dtype with fp32 accumulation: bf16 MXU
+        # rate, fp32 sums (p is fp32 already; cast to v's dtype for the
+        # multiply, accumulate via preferred_element_type).
+        o = o * corr[..., None] + jnp.einsum(
+            "bhqk,bkhd->bhqd", p.astype(v_blk.dtype), v_blk,
+            preferred_element_type=jnp.float32)
+        # Rotate K/V one hop around the ring (nearest ICI neighbor).
+        perm = [(i, (i + 1) % p_size) for i in range(p_size)]
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        return (k_blk, v_blk, m_new, l, o), None
+
+    # Accumulators derived from q (not jnp.zeros) so they carry q's
+    # varying-axis type — lax.scan inside shard_map requires carry-in and
+    # carry-out types to agree.
+    o0 = jnp.swapaxes(q, 1, 2).astype(jnp.float32) * 0   # (B, H, Sq, D)
+    l0 = o0[..., 0]                                      # (B, H, Sq)
+    m0 = l0 + _NEG_INF
+    (_, _, _, l, o), _ = jax.lax.scan(
+        step, (k, v, m0, l0, o0), jnp.arange(p_size))
+    out = o / jnp.maximum(l[..., None], 1e-37)
+    return jnp.einsum("bhqd->bqhd", out).astype(q.dtype)
+
+
+def make_ring_attention(mesh: Optional[Mesh] = None,
+                        axis_name: Optional[str] = None,
+                        causal: bool = False):
+    """Eager/jit face over GLOBAL sequence-sharded arrays (see
+    ``_factory.make_sp_attention``)."""
+    return make_sp_attention(ring_attention, mesh, axis_name, causal)

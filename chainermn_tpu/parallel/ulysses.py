@@ -1,0 +1,83 @@
+"""Ulysses-style sequence parallelism: head↔sequence all-to-all.
+
+Technique: DeepSpeed-Ulysses (Jacobs et al.) — to attend over a sequence
+sharded across ``P`` devices, swap the sharded axis from *sequence* to
+*heads* with one all-to-all, run ordinary full-sequence attention on the
+local ``H/P`` heads, and swap back.  Two XLA ``all_to_all`` collectives
+total, both riding ICI; between them the attention is completely local, so
+any attention kernel (including a Pallas flash kernel) drops in unchanged.
+
+Reference relationship: the reference shipped the raw differentiable
+``alltoall`` (``functions/collective_communication.py`` [uv], SURVEY.md
+§2.8 "EP substrate") but no sequence parallelism on top; this module is
+that missing layer, built on the same primitive's XLA form.
+
+Constraint: ``heads % axis_size == 0`` (head-granular sharding) — the same
+constraint Ulysses itself has.  For head counts below the mesh size use
+ring attention instead (``ring_attention.py``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from ._factory import NEG_INF as _NEG_INF, make_sp_attention
+
+
+def _full_attention(q, k, v, causal: bool):
+    """Plain softmax attention; (B, S, h, D) layout.  Scores and the PV
+    product accumulate in fp32 (``preferred_element_type``) while the
+    matmul operands keep their input dtype — bf16 MXU rate, fp32 sums —
+    matching ring_attention's numerics."""
+    d = q.shape[-1]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) / (d ** 0.5)
+    if causal:
+        s_q, s_k = s.shape[-2:]
+        mask = jnp.arange(s_q)[:, None] >= jnp.arange(s_k)[None, :]
+        s = jnp.where(mask[None, None], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+                     preferred_element_type=jnp.float32)
+    return out.astype(q.dtype)
+
+
+def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                      axis_name: str, causal: bool = False) -> jnp.ndarray:
+    """Exact attention over a sequence-sharded axis via two all-to-alls.
+
+    Call INSIDE ``shard_map``: ``q,k,v`` local shards ``(B, S_local, H, D)``
+    with ``H`` divisible by the axis size; returns the local output shard.
+    """
+    p_size = jax.lax.psum(1, axis_name)
+    b, s_local, h, d = q.shape
+    if h % p_size != 0:
+        raise ValueError(
+            f"Ulysses needs heads ({h}) divisible by axis size ({p_size}); "
+            "use ring_attention for small head counts")
+
+    def seq_to_heads(x):
+        # (B, S_local, H, D) → (B, S_global, H/P, D): hand each device the
+        # full sequence of its H/P heads.
+        return jax.lax.all_to_all(
+            x, axis_name, split_axis=2, concat_axis=1, tiled=True)
+
+    def heads_to_seq(x):
+        return jax.lax.all_to_all(
+            x, axis_name, split_axis=1, concat_axis=2, tiled=True)
+
+    qg, kg, vg = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    out = _full_attention(qg, kg, vg, causal)
+    return heads_to_seq(out)
+
+
+def make_ulysses_attention(mesh: Optional[Mesh] = None,
+                           axis_name: Optional[str] = None,
+                           causal: bool = False):
+    """Eager/jit face over GLOBAL sequence-sharded arrays (see
+    ``_factory.make_sp_attention``)."""
+    return make_sp_attention(ulysses_attention, mesh, axis_name, causal)

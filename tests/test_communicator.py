@@ -11,7 +11,8 @@ import pytest
 
 import chainermn_tpu as mn
 
-COMMS = ["naive", "xla", "pure_nccl", "hierarchical", "flat"]
+COMMS = ["naive", "xla", "pure_nccl", "hierarchical", "flat",
+         "two_dimensional", "single_node", "non_cuda_aware"]
 DTYPES = [np.float32, np.float16, np.int32]
 SIZE = 8
 
