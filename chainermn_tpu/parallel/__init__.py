@@ -1,4 +1,4 @@
-"""Sequence/context parallelism for long-context attention.
+"""Parallelism strategies beyond data parallel: sequence/context and tensor.
 
 The reference predates long-context techniques entirely (SURVEY.md §5
 "long-context: absent — 2017-era codebase"), but its L1/L3 primitives
@@ -17,10 +17,26 @@ from .ulysses import (  # noqa: F401
     make_ulysses_attention,
     ulysses_attention,
 )
+from .tensor_parallel import (  # noqa: F401
+    column_parallel_dense,
+    init_tp_mlp_params,
+    make_tensor_parallel_mlp,
+    row_parallel_dense,
+    tp_mlp,
+    tp_mlp_specs,
+    vocab_parallel_embedding,
+)
 
 __all__ = [
     "ring_attention",
     "make_ring_attention",
     "ulysses_attention",
     "make_ulysses_attention",
+    "column_parallel_dense",
+    "row_parallel_dense",
+    "vocab_parallel_embedding",
+    "tp_mlp",
+    "init_tp_mlp_params",
+    "tp_mlp_specs",
+    "make_tensor_parallel_mlp",
 ]

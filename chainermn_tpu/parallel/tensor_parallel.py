@@ -1,0 +1,171 @@
+"""Intra-layer tensor parallelism: column/row-sharded dense, vocab-sharded embedding.
+
+Reference relationship: the reference has NO tensor-parallel library — its
+parity bar is "expressible manually via ``functions.allgather/alltoall`` +
+split weights" (``chainermn/functions/collective_communication.py`` [uv],
+SURVEY.md §2.8 "TP").  This module is the library the reference left as an
+exercise, built the TPU way: weights are sharded along a named mesh axis,
+the forward is ordinary ``jnp`` matmuls on local shards (MXU-sized, bf16-
+friendly), and the only cross-chip traffic is a single ``psum`` (or
+``all_gather``) that XLA lowers onto ICI.  Gradients need no hand-written
+backward: ``shard_map`` transposes ``psum``/``all_gather`` automatically,
+which is exactly the collective-transpose duality the reference implemented
+by hand in its autograd FunctionNodes (SURVEY.md §2.2).
+
+Layout (Megatron-LM pairing, one collective per MLP block):
+
+* **column-parallel** — kernel sharded on the OUTPUT dim; each chip computes
+  its slice of the features.  No communication unless ``gather_output``.
+* **row-parallel** — kernel sharded on the INPUT dim; chips hold partial
+  sums, one ``psum`` completes the contraction.  Pairing column→row lets a
+  whole MLP (up-projection, nonlinearity, down-projection) run with exactly
+  one all-reduce.
+* **vocab-parallel embedding** — table sharded on the vocab dim; each chip
+  looks up the ids it owns (out-of-range masked to zero), one ``psum``
+  merges.
+
+Two faces, like everything here (SURVEY.md §7 "two faces"): the bare
+functions run INSIDE ``shard_map`` (compose with ring/Ulysses attention,
+pipeline stages, the DP optimizer); ``make_tensor_parallel_mlp`` is the
+eager/jit face over global arrays for tests and small jobs.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..topology import DEFAULT_AXIS_NAME
+
+
+def column_parallel_dense(x, kernel, bias=None, *, axis_name: str,
+                          gather_output: bool = False):
+    """``x @ kernel + bias`` with ``kernel`` sharded on the output dim.
+
+    Call inside ``shard_map``.  ``x``: replicated local ``(..., D_in)``;
+    ``kernel``: local shard ``(D_in, D_out/P)``; ``bias``: local
+    ``(D_out/P,)``.  Returns the local feature slice ``(..., D_out/P)``, or
+    the gathered ``(..., D_out)`` when ``gather_output`` (one all_gather).
+    """
+    y = jnp.matmul(x, kernel, preferred_element_type=jnp.float32)
+    y = y.astype(x.dtype)
+    if bias is not None:
+        y = y + bias
+    if gather_output:
+        y = jax.lax.all_gather(y, axis_name, axis=y.ndim - 1, tiled=True)
+    return y
+
+
+def row_parallel_dense(x, kernel, bias=None, *, axis_name: str,
+                       input_is_parallel: bool = True):
+    """``psum(x_local @ kernel_local) + bias`` — kernel sharded on the input dim.
+
+    Call inside ``shard_map``.  ``x``: local ``(..., D_in/P)`` (the natural
+    output of a column-parallel layer); ``kernel``: local ``(D_in/P,
+    D_out)``; ``bias``: replicated ``(D_out,)``, added AFTER the psum so it
+    is applied once, not P times.  When ``input_is_parallel=False``, ``x``
+    is replicated ``(..., D_in)`` and each chip first slices its own block.
+    """
+    if not input_is_parallel:
+        p = jax.lax.axis_size(axis_name)
+        idx = jax.lax.axis_index(axis_name)
+        block = x.shape[-1] // p
+        x = jax.lax.dynamic_slice_in_dim(x, idx * block, block, axis=x.ndim - 1)
+    y = jnp.matmul(x, kernel, preferred_element_type=jnp.float32)
+    y = jax.lax.psum(y.astype(x.dtype), axis_name)
+    if bias is not None:
+        y = y + bias
+    return y
+
+
+def vocab_parallel_embedding(ids, table, *, axis_name: str):
+    """Embedding lookup with the table sharded on the vocab dim.
+
+    Call inside ``shard_map``.  ``ids``: replicated int ``(...,)``;
+    ``table``: local shard ``(V/P, D)``.  Each chip resolves the ids in its
+    vocab range (others contribute zeros) and one ``psum`` merges — the
+    TPU-native form of a sharded gather.
+    """
+    vocab_per = table.shape[0]
+    start = jax.lax.axis_index(axis_name) * vocab_per
+    local = ids - start
+    in_range = (local >= 0) & (local < vocab_per)
+    rows = jnp.take(table, jnp.clip(local, 0, vocab_per - 1), axis=0)
+    rows = jnp.where(in_range[..., None], rows, 0)
+    return jax.lax.psum(rows, axis_name)
+
+
+def tp_mlp(x, params, *, axis_name: str,
+           activation: Callable = jax.nn.gelu):
+    """Column→activation→row MLP block — ONE psum of cross-chip traffic.
+
+    ``params``: dict with local shards ``wi (D, F/P)``, ``bi (F/P,)``,
+    ``wo (F/P, D)`` and replicated ``bo (D,)``.
+    """
+    h = column_parallel_dense(x, params["wi"], params["bi"],
+                              axis_name=axis_name)
+    h = activation(h)
+    return row_parallel_dense(h, params["wo"], params["bo"],
+                              axis_name=axis_name)
+
+
+def init_tp_mlp_params(rng, d_model: int, d_hidden: int,
+                       dtype=jnp.float32) -> dict:
+    """GLOBAL (unsharded) params for :func:`tp_mlp`; shard with
+    :func:`tp_mlp_specs` or feed through ``make_tensor_parallel_mlp``."""
+    k1, k2 = jax.random.split(rng)
+    scale_i = (2.0 / d_model) ** 0.5
+    scale_o = (2.0 / d_hidden) ** 0.5
+    return {
+        "wi": (jax.random.normal(k1, (d_model, d_hidden)) * scale_i).astype(dtype),
+        "bi": jnp.zeros((d_hidden,), dtype),
+        "wo": (jax.random.normal(k2, (d_hidden, d_model)) * scale_o).astype(dtype),
+        "bo": jnp.zeros((d_model,), dtype),
+    }
+
+
+def tp_mlp_specs(axis_name: str = DEFAULT_AXIS_NAME) -> dict:
+    """PartitionSpecs mapping :func:`init_tp_mlp_params` globals onto the
+    local shards :func:`tp_mlp` expects."""
+    return {
+        "wi": P(None, axis_name),
+        "bi": P(axis_name),
+        "wo": P(axis_name, None),
+        "bo": P(),
+    }
+
+
+def make_tensor_parallel_mlp(mesh: Optional[Mesh] = None,
+                             axis_name: Optional[str] = None,
+                             activation: Callable = jax.nn.gelu):
+    """Eager/jit face: ``fn(x, global_params) -> y`` over global arrays.
+
+    Shards the params per :func:`tp_mlp_specs`, replicates ``x`` across the
+    tensor axis, and runs :func:`tp_mlp` under ``shard_map``; compiles once
+    per shape.  Differentiable end-to-end (shard_map transposes the psum).
+    """
+    from ..topology import make_mesh
+
+    if mesh is None:
+        mesh = make_mesh(axis_name=axis_name or DEFAULT_AXIS_NAME)
+    ax = axis_name or mesh.axis_names[0]
+    specs = tp_mlp_specs(ax)
+
+    fn = shard_map(
+        partial(tp_mlp, axis_name=ax, activation=activation),
+        mesh=mesh, in_specs=(P(), specs), out_specs=P())
+    jitted = jax.jit(fn)
+    param_shardings = {k: NamedSharding(mesh, s) for k, s in specs.items()}
+    x_sharding = NamedSharding(mesh, P())
+
+    def apply(x, params):
+        params = {k: jax.device_put(v, param_shardings[k])
+                  for k, v in params.items()}
+        return jitted(jax.device_put(x, x_sharding), params)
+
+    return apply
