@@ -17,6 +17,11 @@ from .ulysses import (  # noqa: F401
     make_ulysses_attention,
     ulysses_attention,
 )
+from .pipeline import (  # noqa: F401
+    make_pipeline,
+    pipeline_apply,
+    stack_stage_params,
+)
 from .tensor_parallel import (  # noqa: F401
     column_parallel_dense,
     init_tp_mlp_params,
@@ -32,6 +37,9 @@ __all__ = [
     "make_ring_attention",
     "ulysses_attention",
     "make_ulysses_attention",
+    "pipeline_apply",
+    "stack_stage_params",
+    "make_pipeline",
     "column_parallel_dense",
     "row_parallel_dense",
     "vocab_parallel_embedding",

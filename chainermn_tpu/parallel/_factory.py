@@ -1,4 +1,10 @@
-"""Shared eager/jit factory for sequence-parallel attention kernels."""
+"""Shared eager/jit factory plumbing for the parallel-strategy modules.
+
+Every strategy here exposes two faces (SURVEY.md §7): an inside-shard_map
+kernel and an eager/jit wrapper over GLOBAL arrays.  The wrapper recipe is
+always the same — resolve mesh/axis, ``shard_map`` + ``jit`` once, shard the
+global args on the way in — so it lives here once.
+"""
 
 from __future__ import annotations
 
@@ -12,27 +18,42 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 NEG_INF = -1e30
 
 
-def make_sp_attention(kernel: Callable, mesh: Optional[Mesh],
-                      axis_name: Optional[str], causal: bool):
-    """Wrap an inside-shard_map attention kernel ``kernel(q, k, v,
-    axis_name=..., causal=...)`` into ``fn(q, k, v)`` over GLOBAL
-    ``(B, S, H, D)`` arrays sequence-sharded over the mesh axis; compiles
-    once per shape."""
+def resolve_mesh_axis(mesh: Optional[Mesh], axis_name: Optional[str]):
+    """Default mesh = all devices, 1-D; axis = first mesh axis."""
     from ..topology import DEFAULT_AXIS_NAME, make_mesh
 
     if mesh is None:
         mesh = make_mesh(axis_name=axis_name or DEFAULT_AXIS_NAME)
-    ax = axis_name or mesh.axis_names[0]
-    spec = P(None, ax)  # shard the sequence axis
+    return mesh, axis_name or mesh.axis_names[0]
 
-    fn = shard_map(
-        partial(kernel, axis_name=ax, causal=causal),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
-    jitted = jax.jit(fn)
-    sharding = NamedSharding(mesh, spec)
 
-    def apply(q, k, v):
-        q, k, v = (jax.device_put(x, sharding) for x in (q, k, v))
-        return jitted(q, k, v)
+def make_global_apply(kernel: Callable, mesh: Mesh, in_specs, out_specs):
+    """``apply(*args)`` over global arrays: device_put each arg per its
+    in_spec (pytree-prefix shardings allowed), run the jitted shard_map'd
+    kernel; compiles once per shape."""
+    jitted = jax.jit(shard_map(
+        kernel, mesh=mesh, in_specs=in_specs, out_specs=out_specs))
+    shardings = [
+        jax.tree_util.tree_map(lambda s: NamedSharding(mesh, s), spec,
+                               is_leaf=lambda s: isinstance(s, P))
+        for spec in in_specs
+    ]
+
+    def apply(*args):
+        if len(args) != len(shardings):
+            raise TypeError(f"expected {len(shardings)} args, got {len(args)}")
+        return jitted(*jax.device_put(list(args), shardings))
 
     return apply
+
+
+def make_sp_attention(kernel: Callable, mesh: Optional[Mesh],
+                      axis_name: Optional[str], causal: bool):
+    """Wrap an inside-shard_map attention kernel ``kernel(q, k, v,
+    axis_name=..., causal=...)`` into ``fn(q, k, v)`` over GLOBAL
+    ``(B, S, H, D)`` arrays sequence-sharded over the mesh axis."""
+    mesh, ax = resolve_mesh_axis(mesh, axis_name)
+    spec = P(None, ax)  # shard the sequence axis
+    return make_global_apply(
+        partial(kernel, axis_name=ax, causal=causal),
+        mesh, (spec, spec, spec), spec)

@@ -1,0 +1,152 @@
+"""Pipeline parallelism with a real microbatch schedule (GPipe-style).
+
+Reference relationship: the reference's only inter-layer parallelism is
+``MultiNodeChainList`` (``chainermn/links/multi_node_chain_list.py`` [uv]) —
+strictly sequential, one rank active at a time, "no microbatching, no 1F1B
+schedule" (SURVEY.md §2.3, §2.8 "PP: absent").  Our
+``links/multi_node_chain_list.py`` keeps that parity surface; THIS module is
+the scheduler the reference never had, built the TPU way:
+
+* stages live on devices along a named mesh axis — stage ``i``'s weights are
+  the ``i``-th slice of a stage-stacked pytree (sharded by ``shard_map``);
+* the schedule is a ``lax.scan`` over ``M + P - 1`` ticks.  Every tick, all
+  ``P`` devices run the SAME stage function on their in-flight microbatch
+  (SPMD — XLA sees one program, no data-dependent control flow) and a single
+  ``ppermute`` hands activations to the next stage over the ICI ring;
+* backward needs no hand-written schedule: ``lax.scan`` reverses the ticks
+  and the transpose of ``ppermute(+1)`` is ``ppermute(-1)``, so autodiff
+  yields the reverse pipeline automatically — the property the reference
+  hand-built with Send/Recv FunctionNodes (SURVEY.md §3.5).
+
+Bubble fraction is ``(P-1)/(M+P-1)`` (GPipe): pick ``num_microbatches >> P``.
+Memory is O(M) stashed activations; wrap ``stage_fn`` in ``jax.checkpoint``
+to trade FLOPs for HBM (rematerialised backward).
+
+Constraints (the homogeneous-pipeline contract, same as e.g. praxis):
+``stage_fn(stage_params, x) -> y`` with ``y.shape == x.shape`` and
+``y.dtype == x.dtype`` (the activation rides the ring through every stage),
+and ``num_microbatches`` divides the global batch.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def pipeline_apply(stage_fn: Callable, stage_params, x, *, axis_name: str,
+                   num_microbatches: int, squeeze_stage_axis: bool = True):
+    """Run ``x`` through ``P`` pipeline stages with GPipe microbatching.
+
+    Call INSIDE ``shard_map``.  ``stage_params``: this device's stage slice.
+    With ``squeeze_stage_axis=True`` (the default, matching an ``in_spec``
+    of ``P(axis_name)`` over stage-stacked params) every leaf must carry a
+    leading stage axis of length 1, which is stripped before ``stage_fn``
+    sees it; pass ``False`` when handing in an already-squeezed pytree.
+    ``x``: the full local batch ``(B, ...)``, replicated across the axis.
+    Returns ``stage_P-1 ∘ ... ∘ stage_0`` applied to every microbatch, i.e.
+    the same value on every device (merged with one psum at the end).
+    """
+    p_size = jax.lax.axis_size(axis_name)
+    stage = jax.lax.axis_index(axis_name)
+    m = num_microbatches
+    if x.shape[0] % m != 0:
+        raise ValueError(
+            f"batch {x.shape[0]} not divisible by num_microbatches {m}")
+
+    if squeeze_stage_axis:
+        bad = [a.shape for a in jax.tree_util.tree_leaves(stage_params)
+               if a.ndim == 0 or a.shape[0] != 1]
+        if bad:
+            raise ValueError(
+                f"stage_params leaves must carry a leading stage axis of "
+                f"length 1 per device (got shapes {bad}); the stacked stage "
+                f"count must equal the '{axis_name}' mesh axis size "
+                f"({p_size}), or pass squeeze_stage_axis=False for "
+                f"already-squeezed params")
+        stage_params = jax.tree_util.tree_map(lambda a: a[0], stage_params)
+
+    mb = x.reshape((m, x.shape[0] // m) + x.shape[1:])
+    # Pad the injection stream with P-1 zero microbatches so one scan body
+    # covers fill, steady state and drain without data-dependent branches.
+    pad = jnp.zeros((p_size - 1,) + mb.shape[1:], mb.dtype)
+    inject = jnp.concatenate([mb, pad], axis=0)
+
+    def tick(carry, inp):
+        state, out_buf, t = carry
+        # Stage 0 picks up the next microbatch; everyone else keeps the
+        # activation ppermute delivered last tick.
+        state = jnp.where(stage == 0, inp, state)
+        y = stage_fn(stage_params, state)
+        # The last stage emits microbatch t-(P-1) once the pipe is full;
+        # masked writes of zeros during fill are overwritten later.
+        emit = (stage == p_size - 1) & (t >= p_size - 1)
+        slot = jnp.maximum(t - (p_size - 1), 0)
+        out_buf = jax.lax.dynamic_update_index_in_dim(
+            out_buf, jnp.where(emit, y, jnp.zeros_like(y)), slot, axis=0)
+        # Hand the activation to the next stage over the ICI ring.
+        perm = [(i, (i + 1) % p_size) for i in range(p_size)]
+        state = jax.lax.ppermute(y, axis_name, perm=perm)
+        return (state, out_buf, t + 1), None
+
+    # The carry becomes device-varying inside the loop (ppermute /
+    # stage-dependent writes), so the initial carry must carry that type too.
+    def varying_zeros(shape, dtype):
+        z = jnp.zeros(shape, dtype)
+        pcast = getattr(jax.lax, "pcast", None)
+        if pcast is not None:
+            return pcast(z, axis_name, to="varying")
+        return jax.lax.pvary(z, axis_name)
+
+    state0 = varying_zeros(mb.shape[1:], mb.dtype)
+    out0 = varying_zeros(mb.shape, mb.dtype)
+    (_, out_buf, _), _ = jax.lax.scan(
+        tick, (state0, out0, jnp.int32(0)), inject)
+
+    # Only the last stage holds real outputs (others all-zero): one psum
+    # replicates the result — the in-jit form of "bcast from the last rank".
+    out = jax.lax.psum(out_buf, axis_name)
+    return out.reshape(x.shape)
+
+
+def stack_stage_params(per_stage_params) -> object:
+    """Stack a list of per-stage pytrees (one per stage, same structure)
+    into the stage-stacked pytree ``make_pipeline`` shards: every leaf gains
+    a leading axis of length ``P``."""
+    return jax.tree_util.tree_map(
+        lambda *leaves: jnp.stack(leaves), *per_stage_params)
+
+
+def make_pipeline(stage_fn: Callable, mesh: Optional[Mesh] = None,
+                  axis_name: Optional[str] = None,
+                  num_microbatches: int = 8):
+    """Eager/jit face: ``fn(stage_stacked_params, x) -> y`` over globals.
+
+    ``stage_stacked_params``: pytree whose leaves have leading dim ``P``
+    (see :func:`stack_stage_params`); it is sharded one-stage-per-device
+    along the mesh axis, ``x`` replicated; compiles once per shape.
+    Differentiable: param grads come back stage-stacked.
+    """
+    from ._factory import make_global_apply, resolve_mesh_axis
+
+    mesh, ax = resolve_mesh_axis(mesh, axis_name)
+    n_stages = mesh.shape[ax]
+    inner = make_global_apply(
+        partial(pipeline_apply, stage_fn, axis_name=ax,
+                num_microbatches=num_microbatches),
+        mesh, (P(ax), P()), P())
+
+    def apply(stage_stacked_params, x):
+        for leaf in jax.tree_util.tree_leaves(stage_stacked_params):
+            if leaf.ndim == 0 or leaf.shape[0] != n_stages:
+                raise ValueError(
+                    f"stage-stacked leaf has leading dim "
+                    f"{leaf.shape[0] if leaf.ndim else None}, but the "
+                    f"'{ax}' mesh axis has {n_stages} stages")
+        return inner(stage_stacked_params, x)
+
+    return apply

@@ -37,8 +37,7 @@ from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 from ..topology import DEFAULT_AXIS_NAME
 
@@ -149,23 +148,10 @@ def make_tensor_parallel_mlp(mesh: Optional[Mesh] = None,
     tensor axis, and runs :func:`tp_mlp` under ``shard_map``; compiles once
     per shape.  Differentiable end-to-end (shard_map transposes the psum).
     """
-    from ..topology import make_mesh
+    from ._factory import make_global_apply, resolve_mesh_axis
 
-    if mesh is None:
-        mesh = make_mesh(axis_name=axis_name or DEFAULT_AXIS_NAME)
-    ax = axis_name or mesh.axis_names[0]
+    mesh, ax = resolve_mesh_axis(mesh, axis_name)
     specs = tp_mlp_specs(ax)
-
-    fn = shard_map(
+    return make_global_apply(
         partial(tp_mlp, axis_name=ax, activation=activation),
-        mesh=mesh, in_specs=(P(), specs), out_specs=P())
-    jitted = jax.jit(fn)
-    param_shardings = {k: NamedSharding(mesh, s) for k, s in specs.items()}
-    x_sharding = NamedSharding(mesh, P())
-
-    def apply(x, params):
-        params = {k: jax.device_put(v, param_shardings[k])
-                  for k, v in params.items()}
-        return jitted(jax.device_put(x, x_sharding), params)
-
-    return apply
+        mesh, (P(), specs), P())

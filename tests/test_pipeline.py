@@ -1,0 +1,126 @@
+"""Pipeline-parallelism tests: GPipe schedule vs sequential-stack oracle.
+
+Reference relationship: the reference's MultiNodeChainList runs stages
+strictly sequentially (SURVEY.md §2.3 "no microbatching, no 1F1B"); its
+tests (``links_tests/test_multi_node_chain_list.py`` [uv]) checked the
+pipelined graph against the equivalent single-process model.  Same oracle
+here: P stage functions composed on one device, forward AND gradients,
+which exercises the scan-reversal backward pipeline.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import chainermn_tpu as mn
+from chainermn_tpu.parallel import make_pipeline, stack_stage_params
+
+B, D = 16, 8
+N_STAGES = 8
+
+
+def stage_fn(params, x):
+    """One dense+tanh block; output shape == input shape (ring contract)."""
+    return jnp.tanh(x @ params["w"] + params["b"])
+
+
+def make_params(seed=0):
+    rng = np.random.RandomState(seed)
+    return [{"w": rng.randn(D, D).astype(np.float32) * 0.5,
+             "b": rng.randn(D).astype(np.float32) * 0.1}
+            for _ in range(N_STAGES)]
+
+
+def oracle(per_stage, x):
+    for p in per_stage:
+        x = stage_fn(p, x)
+    return x
+
+
+@pytest.fixture(scope="module")
+def mesh(devices):
+    return mn.make_mesh(devices)
+
+
+class TestForward:
+    @pytest.mark.parametrize("num_microbatches", [1, 4, 16])
+    def test_matches_sequential(self, mesh, num_microbatches):
+        per_stage = make_params()
+        stacked = stack_stage_params(per_stage)
+        x = np.random.RandomState(1).randn(B, D).astype(np.float32)
+        fn = make_pipeline(stage_fn, mesh=mesh,
+                           num_microbatches=num_microbatches)
+        got = np.asarray(fn(stacked, x))
+        want = np.asarray(oracle(per_stage, x))
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+    def test_dtype_preserved_bf16(self, mesh):
+        per_stage = make_params()
+        stacked = jax.tree_util.tree_map(
+            lambda a: a.astype(jnp.bfloat16), stack_stage_params(per_stage))
+        x = jnp.asarray(np.random.RandomState(2).randn(B, D), jnp.bfloat16)
+        out = make_pipeline(stage_fn, mesh=mesh, num_microbatches=4)(stacked, x)
+        assert out.dtype == jnp.bfloat16
+
+    def test_stage_count_mismatch_error(self, mesh):
+        """16 stacked stages on an 8-device axis must fail loudly, not
+        hand stage_fn params with a leftover stage axis."""
+        rng = np.random.RandomState(0)
+        per_stage = [{"w": rng.randn(D, D).astype(np.float32)}
+                     for _ in range(2 * N_STAGES)]
+        stacked = stack_stage_params(per_stage)
+        x = np.zeros((B, D), np.float32)
+        with pytest.raises(ValueError, match="stages"):
+            make_pipeline(stage_fn, mesh=mesh, num_microbatches=4)(stacked, x)
+
+    def test_batch_divisibility_error(self, mesh):
+        stacked = stack_stage_params(make_params())
+        x = np.zeros((10, D), np.float32)  # 10 % 4 != 0
+        with pytest.raises(ValueError, match="divisible"):
+            make_pipeline(stage_fn, mesh=mesh, num_microbatches=4)(stacked, x)
+
+
+class TestBackward:
+    def test_gradients_match_sequential(self, mesh):
+        """Backward pipeline = scan reversal + ppermute transpose; grads of
+        every stage's weights must equal the single-device chain rule."""
+        per_stage = make_params(seed=3)
+        stacked = stack_stage_params(per_stage)
+        x = np.random.RandomState(4).randn(B, D).astype(np.float32)
+        fn = make_pipeline(stage_fn, mesh=mesh, num_microbatches=4)
+
+        got = jax.grad(lambda p: (fn(p, x) ** 2).sum())(stacked)
+        want_per_stage = jax.grad(
+            lambda ps: (oracle(ps, x) ** 2).sum())(per_stage)
+        want = stack_stage_params(want_per_stage)
+        for k in ("w", "b"):
+            np.testing.assert_allclose(
+                np.asarray(got[k]), np.asarray(want[k]),
+                rtol=1e-4, atol=1e-5, err_msg=f"grad wrt {k}")
+
+    def test_input_gradient(self, mesh):
+        per_stage = make_params(seed=5)
+        stacked = stack_stage_params(per_stage)
+        x = np.random.RandomState(6).randn(B, D).astype(np.float32)
+        fn = make_pipeline(stage_fn, mesh=mesh, num_microbatches=8)
+        got = jax.grad(lambda x: (fn(stacked, x) ** 2).sum())(x)
+        want = jax.grad(lambda x: (oracle(per_stage, x) ** 2).sum())(x)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-4, atol=1e-5)
+
+
+class TestRemat:
+    def test_checkpointed_stage_fn(self, mesh):
+        """jax.checkpoint-wrapped stages (the HBM-saving config) must not
+        change values or gradients."""
+        per_stage = make_params(seed=7)
+        stacked = stack_stage_params(per_stage)
+        x = np.random.RandomState(8).randn(B, D).astype(np.float32)
+        fn = make_pipeline(jax.checkpoint(stage_fn), mesh=mesh,
+                           num_microbatches=4)
+        got = jax.grad(lambda p: (fn(p, x) ** 2).sum())(stacked)
+        want = stack_stage_params(jax.grad(
+            lambda ps: (oracle(ps, x) ** 2).sum())(per_stage))
+        np.testing.assert_allclose(np.asarray(got["w"]), np.asarray(want["w"]),
+                                   rtol=1e-4, atol=1e-5)
