@@ -11,10 +11,12 @@ or mpi4py anywhere in the import graph.
 from . import extensions, functions, global_except_hook, iterators, links, ops, parallel, training  # noqa: F401
 from .parallel import (  # noqa: F401
     column_parallel_dense,
+    make_moe_mlp,
     make_pipeline,
     make_ring_attention,
     make_tensor_parallel_mlp,
     make_ulysses_attention,
+    moe_mlp,
     pipeline_apply,
     ring_attention,
     row_parallel_dense,

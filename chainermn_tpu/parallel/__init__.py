@@ -17,6 +17,12 @@ from .ulysses import (  # noqa: F401
     make_ulysses_attention,
     ulysses_attention,
 )
+from .moe import (  # noqa: F401
+    init_moe_mlp_params,
+    make_moe_mlp,
+    moe_mlp,
+    moe_mlp_specs,
+)
 from .pipeline import (  # noqa: F401
     make_pipeline,
     pipeline_apply,
@@ -40,6 +46,10 @@ __all__ = [
     "pipeline_apply",
     "stack_stage_params",
     "make_pipeline",
+    "moe_mlp",
+    "init_moe_mlp_params",
+    "moe_mlp_specs",
+    "make_moe_mlp",
     "column_parallel_dense",
     "row_parallel_dense",
     "vocab_parallel_embedding",

@@ -1,0 +1,153 @@
+"""Expert parallelism: mixture-of-experts layer with all-to-all dispatch.
+
+Reference relationship: SURVEY.md §2.8 lists EP as absent from the
+reference — "``alltoall`` primitive exists, which is the EP substrate"
+(``chainermn/functions/collective_communication.py`` [uv]).  This module is
+the layer the substrate was pointing at, built the TPU way (the
+Switch-Transformer / Mesh-TF dispatch formulation, which XLA maps well):
+
+* routing is a dense argmax + cumsum over a ``(tokens, experts)`` one-hot —
+  static shapes, no sorting, no dynamic gather — so the whole layer stays
+  inside one jitted SPMD program;
+* experts are sharded along a named mesh axis (``E_local = E / P`` experts
+  per device) and tokens travel to their expert and back with exactly TWO
+  ``jax.lax.all_to_all`` collectives riding ICI;
+* capacity is fixed (``ceil(T/E * capacity_factor)``): overflow tokens are
+  dropped (contribute zero, standard Switch behavior), keeping every shape
+  static for XLA;
+* the load-balancing auxiliary loss (Switch eq. 4) comes back alongside the
+  output; gradients flow through dispatch/combine einsums and the
+  all_to_alls automatically (shard_map transposes them).
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..topology import DEFAULT_AXIS_NAME
+
+
+def moe_mlp(x, params, *, axis_name: str, num_experts: int,
+            capacity_factor: float = 1.25, activation=jax.nn.gelu):
+    """Top-1 (Switch) MoE MLP over expert-sharded weights.
+
+    Call INSIDE ``shard_map``.  ``x``: local token shard ``(T, D)`` (token/
+    batch axis sharded over ``axis_name``).  ``params``:
+
+    * ``router``: replicated ``(D, E)``;
+    * ``wi (E_local, D, F)``, ``bi (E_local, F)``, ``wo (E_local, F, D)``,
+      ``bo (E_local, D)``: this device's expert shards (``in_spec
+      P(axis_name)`` over globally expert-stacked weights).
+
+    Returns ``(y, aux_loss)``: ``y (T, D)`` with dropped tokens zero,
+    ``aux_loss`` the load-balancing scalar (already globally averaged).
+    """
+    p_size = jax.lax.axis_size(axis_name)
+    e = num_experts
+    if e % p_size != 0:
+        raise ValueError(f"num_experts {e} not divisible by axis size {p_size}")
+    e_local = e // p_size
+    t, d = x.shape
+    capacity = int(math.ceil(t / e * capacity_factor))
+
+    # --- route: top-1 per token, fp32 softmax for stable gating ---
+    logits = jnp.matmul(x, params["router"],
+                        preferred_element_type=jnp.float32)  # (T, E)
+    probs = jax.nn.softmax(logits, axis=-1)
+    expert_idx = jnp.argmax(probs, axis=-1)                  # (T,)
+    onehot = jax.nn.one_hot(expert_idx, e, dtype=probs.dtype)  # (T, E)
+    gate = jnp.sum(probs * onehot, axis=-1)                  # (T,)
+
+    # Load-balancing aux (Switch eq. 4): E * Σ_e fraction_e * mean_prob_e,
+    # averaged over devices so every rank computes the same scalar.
+    fraction = jnp.mean(onehot, axis=0)
+    mean_prob = jnp.mean(probs, axis=0)
+    aux = e * jnp.sum(fraction * mean_prob)
+    aux = jax.lax.pmean(aux, axis_name)
+
+    # --- dispatch tensors: position of each token within its expert ---
+    # (cumsum-1)*onehot is zero at non-assigned entries, so the row sum is
+    # exactly the token's arrival index at its expert.
+    position = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot   # (T, E)
+    pos_idx = jnp.sum(position, axis=-1).astype(jnp.int32)   # (T,)
+    keep = pos_idx < capacity
+    pos_onehot = jax.nn.one_hot(pos_idx, capacity, dtype=x.dtype)  # (T, C)
+    dispatch = (onehot.astype(x.dtype)[:, :, None] * pos_onehot[:, None, :]
+                * keep[:, None, None])                       # (T, E, C)
+    combine = dispatch * gate.astype(x.dtype)[:, None, None]  # (T, E, C)
+
+    # --- to experts: (T,E,C)×(T,D) → (E,C,D), then all_to_all over ICI ---
+    expert_in = jnp.einsum("tec,td->ecd", dispatch, x)
+    # Split the expert dim across devices; receive every device's tokens
+    # for MY local experts: (E, C, D) → (P·E_local, C, D) blocks.
+    recv = jax.lax.all_to_all(expert_in, axis_name, split_axis=0,
+                              concat_axis=0, tiled=True)
+    # Block p holds device p's tokens for my experts; group per expert.
+    recv = recv.reshape(p_size, e_local, capacity, d)
+    recv = recv.transpose(1, 0, 2, 3).reshape(e_local, p_size * capacity, d)
+
+    # --- expert compute: batched matmuls, MXU-friendly ---
+    h = jnp.einsum("egd,edf->egf", recv, params["wi"],
+                   preferred_element_type=jnp.float32).astype(x.dtype)
+    h = activation(h + params["bi"][:, None, :])
+    out = jnp.einsum("egf,efd->egd", h, params["wo"],
+                     preferred_element_type=jnp.float32).astype(x.dtype)
+    out = out + params["bo"][:, None, :]
+
+    # --- back to token owners: inverse reshuffle + second all_to_all ---
+    out = out.reshape(e_local, p_size, capacity, d).transpose(1, 0, 2, 3)
+    out = out.reshape(e, capacity, d)
+    back = jax.lax.all_to_all(out, axis_name, split_axis=0,
+                              concat_axis=0, tiled=True)     # (E, C, D)
+    y = jnp.einsum("tec,ecd->td", combine, back)
+    return y.astype(x.dtype), aux.astype(x.dtype)
+
+
+def init_moe_mlp_params(rng, d_model: int, d_hidden: int, num_experts: int,
+                        dtype=jnp.float32) -> dict:
+    """GLOBAL params for :func:`moe_mlp` (expert-stacked leaves, leading dim
+    ``E``); shard per :func:`moe_mlp_specs`."""
+    kr, k1, k2 = jax.random.split(rng, 3)
+    e = num_experts
+    si = (2.0 / d_model) ** 0.5
+    so = (2.0 / d_hidden) ** 0.5
+    return {
+        "router": (jax.random.normal(kr, (d_model, e)) * 0.02).astype(dtype),
+        "wi": (jax.random.normal(k1, (e, d_model, d_hidden)) * si).astype(dtype),
+        "bi": jnp.zeros((e, d_hidden), dtype),
+        "wo": (jax.random.normal(k2, (e, d_hidden, d_model)) * so).astype(dtype),
+        "bo": jnp.zeros((e, d_model), dtype),
+    }
+
+
+def moe_mlp_specs(axis_name: str = DEFAULT_AXIS_NAME) -> dict:
+    """PartitionSpecs: router replicated, expert-stacked weights sharded on
+    the expert-stack (leading) dim."""
+    return {
+        "router": P(),
+        "wi": P(axis_name),
+        "bi": P(axis_name),
+        "wo": P(axis_name),
+        "bo": P(axis_name),
+    }
+
+
+def make_moe_mlp(num_experts: int, mesh: Optional[Mesh] = None,
+                 axis_name: Optional[str] = None,
+                 capacity_factor: float = 1.25, activation=jax.nn.gelu):
+    """Eager/jit face: ``fn(x, global_params) -> (y, aux)`` over global
+    arrays, tokens sharded over the mesh axis; compiles once per shape."""
+    from ._factory import make_global_apply, resolve_mesh_axis
+
+    mesh, ax = resolve_mesh_axis(mesh, axis_name)
+    specs = moe_mlp_specs(ax)
+    return make_global_apply(
+        partial(moe_mlp, axis_name=ax, num_experts=num_experts,
+                capacity_factor=capacity_factor, activation=activation),
+        mesh, (P(ax), specs), (P(ax), P()))

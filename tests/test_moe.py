@@ -1,0 +1,124 @@
+"""Expert-parallel MoE tests vs a dense single-device oracle.
+
+Reference relationship: EP is absent from the reference (SURVEY.md §2.8 —
+"alltoall primitive exists, which is the EP substrate"); the oracle is the
+dense per-token computation: route each token to its argmax expert, scale
+by the gate, zero if over capacity.  Forward AND gradients are checked
+across the 8-device mesh (two all_to_alls on the dispatch path).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import chainermn_tpu as mn
+from chainermn_tpu.parallel import init_moe_mlp_params, make_moe_mlp
+
+T, D, F, E = 64, 8, 16, 8  # tokens, d_model, d_hidden, experts (= devices)
+
+
+@pytest.fixture(scope="module")
+def mesh(devices):
+    return mn.make_mesh(devices)
+
+
+def params_and_tokens(seed=0, num_experts=E):
+    params = init_moe_mlp_params(
+        jax.random.PRNGKey(seed), D, F, num_experts)
+    x = np.random.RandomState(seed).randn(T, D).astype(np.float32)
+    return params, x
+
+
+def oracle(x, params, capacity_per_device_expert=None, tokens_per_device=None):
+    """Dense reference: each token → argmax expert, gated; tokens beyond an
+    expert's capacity WITHIN THEIR DEVICE SHARD are dropped to zero."""
+    probs = np.asarray(jax.nn.softmax(x @ np.asarray(params["router"]), axis=-1))
+    out = np.zeros_like(x)
+    e = probs.shape[-1]
+    tpd = tokens_per_device or len(x)
+    for dev_start in range(0, len(x), tpd):
+        counts = np.zeros(e, int)
+        for t in range(dev_start, dev_start + tpd):
+            ei = int(probs[t].argmax())
+            counts[ei] += 1
+            if (capacity_per_device_expert is not None
+                    and counts[ei] > capacity_per_device_expert):
+                continue  # dropped
+            h = np.asarray(jax.nn.gelu(
+                jnp.asarray(x[t] @ np.asarray(params["wi"][ei])
+                            + np.asarray(params["bi"][ei]))))
+            y = h @ np.asarray(params["wo"][ei]) + np.asarray(params["bo"][ei])
+            out[t] = probs[t, ei] * y
+    return out
+
+
+class TestForward:
+    def test_matches_dense_oracle_no_drops(self, mesh):
+        params, x = params_and_tokens()
+        # capacity_factor=E → capacity = local T, nothing ever drops.
+        fn = make_moe_mlp(E, mesh=mesh, capacity_factor=float(E))
+        y, aux = fn(x, params)
+        want = oracle(x, params)
+        np.testing.assert_allclose(np.asarray(y), want, rtol=1e-4, atol=1e-5)
+        assert float(aux) > 0
+
+    def test_capacity_drops_tokens(self, mesh):
+        params, x = params_and_tokens(seed=1)
+        fn = make_moe_mlp(E, mesh=mesh, capacity_factor=1.0)
+        y, _ = fn(x, params)
+        # capacity = (T/P)/E * 1.0 = 1 token per (device, expert)
+        want = oracle(x, params, capacity_per_device_expert=1,
+                      tokens_per_device=T // 8)
+        np.testing.assert_allclose(np.asarray(y), want, rtol=1e-4, atol=1e-5)
+
+    def test_bf16_dtype_preserved(self, mesh):
+        params, x = params_and_tokens(seed=2)
+        params = jax.tree_util.tree_map(
+            lambda a: a.astype(jnp.bfloat16), params)
+        fn = make_moe_mlp(E, mesh=mesh, capacity_factor=float(E))
+        y, aux = fn(jnp.asarray(x, jnp.bfloat16), params)
+        assert y.dtype == jnp.bfloat16
+
+    def test_experts_divisibility_error(self, mesh):
+        params, x = params_and_tokens(num_experts=6)
+        with pytest.raises(ValueError, match="divisible"):
+            make_moe_mlp(6, mesh=mesh)(x, params)
+
+
+class TestBackward:
+    def test_gradients_match_dense(self, mesh):
+        """Grad of a no-drop MoE == grad of the dense gated computation
+        (exercises the transposes of both all_to_alls)."""
+        params, x = params_and_tokens(seed=3)
+        fn = make_moe_mlp(E, mesh=mesh, capacity_factor=float(E))
+
+        def dist_loss(p):
+            y, _ = fn(x, p)
+            return (y ** 2).sum()
+
+        def ref_loss(p):
+            probs = jax.nn.softmax(x @ p["router"], axis=-1)
+            ei = jnp.argmax(probs, axis=-1)
+            gate = jnp.take_along_axis(probs, ei[:, None], axis=-1)[:, 0]
+            h = jax.nn.gelu(
+                jnp.einsum("td,tdf->tf", x, p["wi"][ei]) + p["bi"][ei])
+            y = jnp.einsum("tf,tfd->td", h, p["wo"][ei]) + p["bo"][ei]
+            return ((gate[:, None] * y) ** 2).sum()
+
+        got = jax.grad(dist_loss)(params)
+        want = jax.grad(ref_loss)(params)
+        for k in params:
+            np.testing.assert_allclose(
+                np.asarray(got[k]), np.asarray(want[k]),
+                rtol=2e-3, atol=1e-4, err_msg=f"grad wrt {k}")
+
+
+class TestLoadBalanceAux:
+    def test_uniform_routing_gives_min_aux(self, mesh):
+        """With a zero router every expert gets prob 1/E → aux ≈ 1 (its
+        theoretical minimum for top-1)."""
+        params, x = params_and_tokens(seed=4)
+        params = dict(params, router=jnp.zeros_like(params["router"]))
+        _, aux = make_moe_mlp(E, mesh=mesh, capacity_factor=float(E))(x, params)
+        assert float(aux) == pytest.approx(1.0, rel=1e-3)
