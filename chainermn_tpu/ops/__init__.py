@@ -1,3 +1,4 @@
+from .flash_attention import flash_attention  # noqa: F401
 from .collective import (  # noqa: F401
     all_gather,
     all_to_all,

@@ -1,0 +1,240 @@
+"""Flash attention as a Pallas TPU kernel (XLA blockwise backward).
+
+Reference relationship: the reference's only runtime-compiled device code
+was CuPy's fused cast/scale CUDA kernels on the allreduce path
+(``chainermn/communicators/pure_nccl_communicator.py`` [uv], SURVEY.md
+§2.7); attention itself predates it entirely.  This is the TPU-native
+analog of "hand-write the hot kernel": the O(S²) score matrix never
+touches HBM — Q/K/V stream through VMEM in MXU-sized tiles and the online-
+softmax state (m, l, acc) lives in VMEM scratch across the K-block grid
+dimension (pallas_guide.md §4/§8 revolving-accumulator pattern).
+
+Forward: one Pallas kernel, grid ``(B·H, S/block_q, S/block_k)``, the last
+dimension sequential ("arbitrary") so scratch accumulates across K blocks.
+Saves the log-sum-exp alongside the output.
+
+Backward: memory-efficient XLA ``lax.scan`` over K blocks that recomputes
+probabilities from the saved LSE (`p = exp(s − lse)` is the exact softmax,
+no renormalisation pass needed) — O(S·block) live memory, no O(S²) tensor.
+On CPU (tests, debugging) the kernel runs in Pallas interpret mode; the
+math is identical.
+
+Layout: ``(B, S, H, D)`` — the same convention as ``parallel/``'s ring and
+Ulysses attention, which uses this kernel for its local (post-all-to-all)
+attention when ``attn_impl='flash'``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+_LANES = 128  # TPU vector lane count: scratch vectors are (block_q, 128)
+
+
+def _pick_block(s: int, want: int) -> int:
+    """Largest block ≤ want that divides s (static shapes, no padding)."""
+    for b in range(min(want, s), 0, -1):
+        if s % b == 0:
+            return b
+    return 1
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                acc_ref, m_ref, l_ref, *, scale, causal,
+                block_q, block_k, num_kblocks):
+    iq, ik = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    # Causal: K blocks entirely above the diagonal contribute nothing —
+    # skip their matmuls (≈2× FLOP saving at long S).
+    run = (ik * block_k <= iq * block_q + block_q - 1) if causal else True
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0]                                   # (block_q, D)
+        k = k_ref[0]                                   # (block_k, D)
+        v = v_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # (block_q, block_k)
+
+        if causal:
+            q_pos = iq * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = ik * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            mask = q_pos >= k_pos
+            s = jnp.where(mask, s, NEG_INF)
+
+        m_prev = m_ref[:, :1]                          # (block_q, 1)
+        l_prev = l_ref[:, :1]
+        m_new = jnp.maximum(m_prev, s.max(-1, keepdims=True))
+        # NEG_INF is finite, so exp(s - m_new) alone would turn fully-masked
+        # rows into 1s — multiply by the mask explicitly.
+        p = jnp.exp(s - m_new)
+        if causal:
+            p = jnp.where(mask, p, 0.0)
+        alpha = jnp.exp(m_prev - m_new)                # (block_q, 1)
+        l_new = l_prev * alpha + p.sum(-1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    # For causal, the last contributing K block for this Q block is the one
+    # covering the diagonal, not num_kblocks-1.
+    if causal:
+        last_ik = jnp.minimum(
+            (iq * block_q + block_q - 1) // block_k, num_kblocks - 1)
+    else:
+        last_ik = num_kblocks - 1
+
+    @pl.when(ik == last_ik)
+    def _finalize():
+        l = l_ref[:, :1]
+        o_ref[0] = (acc_ref[...] / jnp.maximum(l, 1e-37)).astype(o_ref.dtype)
+        # LSE is lane-replicated (block_q, LANES) — Mosaic needs the last
+        # two block dims tileable; callers slice [..., 0].
+        lse_ref[0] = m_ref[...] + jnp.log(jnp.maximum(l_ref[...], 1e-37))
+
+
+def _inherit_vma(*xs) -> frozenset:
+    """Union of the inputs' varying-mesh-axes sets — pallas_call inside
+    shard_map requires out_shapes to declare how outputs vary."""
+    vma = set()
+    for x in xs:
+        aval = getattr(x, "aval", None)
+        v = getattr(aval, "vma", None)
+        if v:
+            vma |= set(v)
+    return frozenset(vma)
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    bh, s, d = q.shape
+    bq = _pick_block(s, block_q)
+    bk = _pick_block(s, block_k)
+    nq, nk = s // bq, s // bk
+    vma = _inherit_vma(q, k, v)
+
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal,
+        block_q=bq, block_k=bk, num_kblocks=nk)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq, _LANES), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s, d), q.dtype, vma=vma),
+            jax.ShapeDtypeStruct((bh, s, _LANES), jnp.float32, vma=vma),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, d), jnp.float32),
+            pltpu.VMEM((bq, _LANES), jnp.float32),
+            pltpu.VMEM((bq, _LANES), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(q, k, v)
+    return out, lse[..., 0]
+
+
+def _bwd_blockwise(q, k, v, out, lse, do, causal, scale, block_k):
+    """Memory-efficient backward: scan over K blocks, recomputing p from
+    the saved LSE.  All operands (BH, S, D); returns (dq, dk, dv)."""
+    bh, s, d = q.shape
+    bk = _pick_block(s, block_k)
+    nk = s // bk
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1)                                  # (BH, S)
+    q_pos = jnp.arange(s)
+
+    def step(dq_acc, ik):
+        kb = jax.lax.dynamic_slice_in_dim(k, ik * bk, bk, axis=1)
+        vb = jax.lax.dynamic_slice_in_dim(v, ik * bk, bk, axis=1)
+        sc = jnp.einsum("bqd,bkd->bqk", q, kb,
+                        preferred_element_type=jnp.float32) * scale
+        p = jnp.exp(sc - lse[..., None])                      # exact softmax
+        if causal:
+            k_pos = ik * bk + jnp.arange(bk)
+            mask = q_pos[:, None] >= k_pos[None, :]
+            p = jnp.where(mask[None], p, 0.0)
+        dv_b = jnp.einsum("bqk,bqd->bkd", p.astype(do.dtype), do,
+                          preferred_element_type=jnp.float32)
+        dp = jnp.einsum("bqd,bkd->bqk", do, vb,
+                        preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[..., None]) * scale              # (BH, S, bk)
+        dq_acc = dq_acc + jnp.einsum("bqk,bkd->bqd", ds.astype(kb.dtype), kb,
+                                     preferred_element_type=jnp.float32)
+        dk_b = jnp.einsum("bqk,bqd->bkd", ds.astype(q.dtype), q,
+                          preferred_element_type=jnp.float32)
+        return dq_acc, (dk_b, dv_b)
+
+    dq, (dks, dvs) = jax.lax.scan(
+        step, jnp.zeros(q.shape, jnp.float32), jnp.arange(nk))
+    # (nk, BH, bk, D) → (BH, nk·bk=S, D); blocks were emitted in order.
+    dk = dks.transpose(1, 0, 2, 3).reshape(bh, s, d)
+    dv = dvs.transpose(1, 0, 2, 3).reshape(bh, s, d)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_bhsd(q, k, v, causal, block_q, block_k, interpret):
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    out, _ = _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret)
+    return out
+
+
+def _flash_bhsd_fwd(q, k, v, causal, block_q, block_k, interpret):
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    out, lse = _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bhsd_bwd(causal, block_q, block_k, interpret, res, do):
+    q, k, v, out, lse = res
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    return _bwd_blockwise(q, k, v, out, lse, do, causal, scale, block_k)
+
+
+_flash_bhsd.defvjp(_flash_bhsd_fwd, _flash_bhsd_bwd)
+
+
+def flash_attention(q, k, v, causal: bool = False, block_q: int = 128,
+                    block_k: int = 128, interpret: Optional[bool] = None):
+    """Flash attention over ``(B, S, H, D)`` arrays.
+
+    ``interpret=None`` auto-selects: the compiled Pallas kernel on TPU,
+    interpret mode elsewhere (CPU tests — same math, no Mosaic).  Blocks
+    shrink automatically to divide ``S``.  Differentiable via the blockwise
+    LSE backward; O(S·block) live memory both directions.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    b, s, h, d = q.shape
+    to_bhsd = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, s, x.shape[-1])
+    out = _flash_bhsd(to_bhsd(q), to_bhsd(k), to_bhsd(v),
+                      causal, block_q, block_k, interpret)
+    return out.reshape(b, h, s, d).transpose(0, 2, 1, 3)

@@ -27,12 +27,14 @@ def resolve_mesh_axis(mesh: Optional[Mesh], axis_name: Optional[str]):
     return mesh, axis_name or mesh.axis_names[0]
 
 
-def make_global_apply(kernel: Callable, mesh: Mesh, in_specs, out_specs):
+def make_global_apply(kernel: Callable, mesh: Mesh, in_specs, out_specs,
+                      check_vma: bool = True):
     """``apply(*args)`` over global arrays: device_put each arg per its
     in_spec (pytree-prefix shardings allowed), run the jitted shard_map'd
     kernel; compiles once per shape."""
     jitted = jax.jit(shard_map(
-        kernel, mesh=mesh, in_specs=in_specs, out_specs=out_specs))
+        kernel, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_vma=check_vma))
     shardings = [
         jax.tree_util.tree_map(lambda s: NamedSharding(mesh, s), spec,
                                is_leaf=lambda s: isinstance(s, P))
@@ -48,7 +50,8 @@ def make_global_apply(kernel: Callable, mesh: Mesh, in_specs, out_specs):
 
 
 def make_sp_attention(kernel: Callable, mesh: Optional[Mesh],
-                      axis_name: Optional[str], causal: bool):
+                      axis_name: Optional[str], causal: bool,
+                      check_vma: bool = True):
     """Wrap an inside-shard_map attention kernel ``kernel(q, k, v,
     axis_name=..., causal=...)`` into ``fn(q, k, v)`` over GLOBAL
     ``(B, S, H, D)`` arrays sequence-sharded over the mesh axis."""
@@ -56,4 +59,4 @@ def make_sp_attention(kernel: Callable, mesh: Optional[Mesh],
     spec = P(None, ax)  # shard the sequence axis
     return make_global_apply(
         partial(kernel, axis_name=ax, causal=causal),
-        mesh, (spec, spec, spec), spec)
+        mesh, (spec, spec, spec), spec, check_vma=check_vma)

@@ -47,11 +47,15 @@ def _full_attention(q, k, v, causal: bool):
 
 
 def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
-                      axis_name: str, causal: bool = False) -> jnp.ndarray:
+                      axis_name: str, causal: bool = False,
+                      attn_impl: str = "xla") -> jnp.ndarray:
     """Exact attention over a sequence-sharded axis via two all-to-alls.
 
     Call INSIDE ``shard_map``: ``q,k,v`` local shards ``(B, S_local, H, D)``
     with ``H`` divisible by the axis size; returns the local output shard.
+    ``attn_impl``: ``'xla'`` (plain softmax attention) or ``'flash'`` (the
+    Pallas kernel from ``ops.flash_attention`` — O(block) memory for the
+    local full-sequence attention, the long-context configuration).
     """
     p_size = jax.lax.psum(1, axis_name)
     b, s_local, h, d = q.shape
@@ -71,13 +75,30 @@ def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
             x, axis_name, split_axis=1, concat_axis=2, tiled=True)
 
     qg, kg, vg = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
-    out = _full_attention(qg, kg, vg, causal)
+    if attn_impl == "flash":
+        from ..ops.flash_attention import flash_attention
+        out = flash_attention(qg, kg, vg, causal=causal)
+    elif attn_impl == "xla":
+        out = _full_attention(qg, kg, vg, causal)
+    else:
+        raise ValueError(f"attn_impl must be 'xla' or 'flash', got {attn_impl!r}")
     return heads_to_seq(out)
 
 
 def make_ulysses_attention(mesh: Optional[Mesh] = None,
                            axis_name: Optional[str] = None,
-                           causal: bool = False):
+                           causal: bool = False, attn_impl: str = "xla"):
     """Eager/jit face over GLOBAL sequence-sharded arrays (see
     ``_factory.make_sp_attention``)."""
-    return make_sp_attention(ulysses_attention, mesh, axis_name, causal)
+    from functools import partial
+
+    # check_vma off only for INTERPRETED flash (CPU tests): pallas interpret
+    # mode can't propagate varying-axes through its internal interpreter yet
+    # (JAX limitation).  The compiled TPU path keeps the check.
+    import jax as _jax
+
+    interpreted_flash = (attn_impl == "flash"
+                         and _jax.default_backend() != "tpu")
+    return make_sp_attention(
+        partial(ulysses_attention, attn_impl=attn_impl),
+        mesh, axis_name, causal, check_vma=not interpreted_flash)
