@@ -8,7 +8,8 @@ parallelism is sharding + ppermute instead of MPI send/recv.  No CUDA, NCCL
 or mpi4py anywhere in the import graph.
 """
 
-from . import extensions, functions, global_except_hook, iterators, links, ops, parallel, training  # noqa: F401
+from . import extensions, functions, global_except_hook, iterators, links, ops, parallel, runtime, training  # noqa: F401
+from .runtime import PrefetchIterator  # noqa: F401
 from .parallel import (  # noqa: F401
     column_parallel_dense,
     make_moe_mlp,

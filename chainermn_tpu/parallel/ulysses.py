@@ -19,6 +19,7 @@ ring attention instead (``ring_attention.py``).
 
 from __future__ import annotations
 
+from functools import partial
 from typing import Optional
 
 import jax
@@ -90,15 +91,11 @@ def make_ulysses_attention(mesh: Optional[Mesh] = None,
                            causal: bool = False, attn_impl: str = "xla"):
     """Eager/jit face over GLOBAL sequence-sharded arrays (see
     ``_factory.make_sp_attention``)."""
-    from functools import partial
-
     # check_vma off only for INTERPRETED flash (CPU tests): pallas interpret
     # mode can't propagate varying-axes through its internal interpreter yet
     # (JAX limitation).  The compiled TPU path keeps the check.
-    import jax as _jax
-
     interpreted_flash = (attn_impl == "flash"
-                         and _jax.default_backend() != "tpu")
+                         and jax.default_backend() != "tpu")
     return make_sp_attention(
         partial(ulysses_attention, attn_impl=attn_impl),
         mesh, axis_name, causal, check_vma=not interpreted_flash)
