@@ -44,7 +44,11 @@ from .datasets import (  # noqa: F401
     scatter_index,
 )
 from .evaluators import accuracy_evaluator, create_multi_node_evaluator  # noqa: F401
-from .optimizers import create_multi_node_optimizer, gradient_average  # noqa: F401
+from .optimizers import (  # noqa: F401
+    compressed_mean,
+    create_multi_node_optimizer,
+    gradient_average,
+)
 from .train import (  # noqa: F401
     make_flax_train_step,
     make_train_step,

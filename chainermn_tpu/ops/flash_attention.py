@@ -27,6 +27,7 @@ attention when ``attn_impl='flash'``.
 from __future__ import annotations
 
 import functools
+import math
 from typing import Optional
 
 import jax
@@ -36,6 +37,8 @@ from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
 _LANES = 128  # TPU vector lane count: scratch vectors are (block_q, 128)
+_MIN_BLOCK = 8  # fp32 sublane tile; divisor blocks below this are Mosaic-
+                # hostile (prime S degrades to 1), so we pad+mask instead
 
 
 def _pick_block(s: int, want: int) -> int:
@@ -48,7 +51,7 @@ def _pick_block(s: int, want: int) -> int:
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
                 acc_ref, m_ref, l_ref, *, scale, causal,
-                block_q, block_k, num_kblocks):
+                block_q, block_k, num_kblocks, seq_len):
     iq, ik = pl.program_id(1), pl.program_id(2)
 
     @pl.when(ik == 0)
@@ -57,9 +60,16 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         m_ref[...] = jnp.full_like(m_ref, NEG_INF)
         l_ref[...] = jnp.zeros_like(l_ref)
 
+    # seq_len < the padded S means a masked tail (prime/odd S padded up to
+    # the block size); those K positions must contribute nothing.
+    tail = seq_len is not None
+
     # Causal: K blocks entirely above the diagonal contribute nothing —
-    # skip their matmuls (≈2× FLOP saving at long S).
+    # skip their matmuls (≈2× FLOP saving at long S).  Fully-padded K
+    # blocks likewise.
     run = (ik * block_k <= iq * block_q + block_q - 1) if causal else True
+    if tail:
+        run = jnp.logical_and(run, ik * block_k < seq_len)
 
     @pl.when(run)
     def _body():
@@ -70,12 +80,15 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale  # (block_q, block_k)
 
-        if causal:
+        mask = None
+        if causal or tail:
             q_pos = iq * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             k_pos = ik * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
-            mask = q_pos >= k_pos
+            mask = (q_pos >= k_pos) if causal else (k_pos == k_pos)
+            if tail:
+                mask = jnp.logical_and(mask, k_pos < seq_len)
             s = jnp.where(mask, s, NEG_INF)
 
         m_prev = m_ref[:, :1]                          # (block_q, 1)
@@ -84,7 +97,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         # NEG_INF is finite, so exp(s - m_new) alone would turn fully-masked
         # rows into 1s — multiply by the mask explicitly.
         p = jnp.exp(s - m_new)
-        if causal:
+        if mask is not None:
             p = jnp.where(mask, p, 0.0)
         alpha = jnp.exp(m_prev - m_new)                # (block_q, 1)
         l_new = l_prev * alpha + p.sum(-1, keepdims=True)
@@ -123,7 +136,7 @@ def _inherit_vma(*xs) -> frozenset:
     return frozenset(vma)
 
 
-def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret, seq_len):
     bh, s, d = q.shape
     bq = _pick_block(s, block_q)
     bk = _pick_block(s, block_k)
@@ -132,7 +145,8 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
 
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal,
-        block_q=bq, block_k=bk, num_kblocks=nk)
+        block_q=bq, block_k=bk, num_kblocks=nk,
+        seq_len=None if seq_len == s else seq_len)
     out, lse = pl.pallas_call(
         kernel,
         grid=(bh, nq, nk),
@@ -161,12 +175,13 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
     return out, lse[..., 0]
 
 
-def _bwd_blockwise(q, k, v, out, lse, do, causal, scale, block_k):
+def _bwd_blockwise(q, k, v, out, lse, do, causal, scale, block_k, seq_len):
     """Memory-efficient backward: scan over K blocks, recomputing p from
     the saved LSE.  All operands (BH, S, D); returns (dq, dk, dv)."""
     bh, s, d = q.shape
     bk = _pick_block(s, block_k)
     nk = s // bk
+    tail = seq_len != s
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1)                                  # (BH, S)
     q_pos = jnp.arange(s)
@@ -177,9 +192,15 @@ def _bwd_blockwise(q, k, v, out, lse, do, causal, scale, block_k):
         sc = jnp.einsum("bqd,bkd->bqk", q, kb,
                         preferred_element_type=jnp.float32) * scale
         p = jnp.exp(sc - lse[..., None])                      # exact softmax
-        if causal:
+        if causal or tail:
             k_pos = ik * bk + jnp.arange(bk)
-            mask = q_pos[:, None] >= k_pos[None, :]
+            mask = (q_pos[:, None] >= k_pos[None, :] if causal
+                    else jnp.ones((s, bk), bool))
+            if tail:
+                # Padded q rows have lse ≈ NEG_INF, making exp() overflow to
+                # inf; padded k columns must contribute nothing.  Mask both.
+                mask = (mask & (k_pos[None, :] < seq_len)
+                        & (q_pos[:, None] < seq_len))
             p = jnp.where(mask[None], p, 0.0)
         dv_b = jnp.einsum("bqk,bqd->bkd", p.astype(do.dtype), do,
                           preferred_element_type=jnp.float32)
@@ -200,23 +221,26 @@ def _bwd_blockwise(q, k, v, out, lse, do, causal, scale, block_k):
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash_bhsd(q, k, v, causal, block_q, block_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_bhsd(q, k, v, causal, block_q, block_k, interpret, seq_len):
     scale = 1.0 / (q.shape[-1] ** 0.5)
-    out, _ = _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret)
+    out, _ = _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
+                        seq_len)
     return out
 
 
-def _flash_bhsd_fwd(q, k, v, causal, block_q, block_k, interpret):
+def _flash_bhsd_fwd(q, k, v, causal, block_q, block_k, interpret, seq_len):
     scale = 1.0 / (q.shape[-1] ** 0.5)
-    out, lse = _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret)
+    out, lse = _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
+                          seq_len)
     return out, (q, k, v, out, lse)
 
 
-def _flash_bhsd_bwd(causal, block_q, block_k, interpret, res, do):
+def _flash_bhsd_bwd(causal, block_q, block_k, interpret, seq_len, res, do):
     q, k, v, out, lse = res
     scale = 1.0 / (q.shape[-1] ** 0.5)
-    return _bwd_blockwise(q, k, v, out, lse, do, causal, scale, block_k)
+    return _bwd_blockwise(q, k, v, out, lse, do, causal, scale, block_k,
+                          seq_len)
 
 
 _flash_bhsd.defvjp(_flash_bhsd_fwd, _flash_bhsd_bwd)
@@ -227,14 +251,26 @@ def flash_attention(q, k, v, causal: bool = False, block_q: int = 128,
     """Flash attention over ``(B, S, H, D)`` arrays.
 
     ``interpret=None`` auto-selects: the compiled Pallas kernel on TPU,
-    interpret mode elsewhere (CPU tests — same math, no Mosaic).  Blocks
-    shrink automatically to divide ``S``.  Differentiable via the blockwise
-    LSE backward; O(S·block) live memory both directions.
+    interpret mode elsewhere (CPU tests — same math, no Mosaic).  When ``S``
+    is a multiple of a reasonable block, blocks shrink to the largest
+    divisor; otherwise (prime/small-factor S, where divisor-shrinking would
+    degrade to Mosaic-hostile tiny blocks) ``S`` is padded up to the block
+    size and the tail masked inside the kernel.  Differentiable via the
+    blockwise LSE backward; O(S·block) live memory both directions.
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     b, s, h, d = q.shape
-    to_bhsd = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, s, x.shape[-1])
+    s_pad = s
+    if min(_pick_block(s, block_q), _pick_block(s, block_k)) < _MIN_BLOCK:
+        lcm = block_q * block_k // math.gcd(block_q, block_k)
+        s_pad = -(-s // lcm) * lcm
+        pad = [(0, 0), (0, s_pad - s), (0, 0), (0, 0)]
+        q, k, v = (jnp.pad(x, pad) for x in (q, k, v))
+
+    def to_bhsd(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, s_pad, x.shape[-1])
+
     out = _flash_bhsd(to_bhsd(q), to_bhsd(k), to_bhsd(v),
-                      causal, block_q, block_k, interpret)
-    return out.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+                      causal, block_q, block_k, interpret, s)
+    return out.reshape(b, h, s_pad, d)[:, :, :s].transpose(0, 2, 1, 3)

@@ -64,12 +64,14 @@ def moe_mlp(x, params, *, axis_name: str, num_experts: int,
     onehot = jax.nn.one_hot(expert_idx, e, dtype=probs.dtype)  # (T, E)
     gate = jnp.sum(probs * onehot, axis=-1)                  # (T,)
 
-    # Load-balancing aux (Switch eq. 4): E * Σ_e fraction_e * mean_prob_e,
-    # averaged over devices so every rank computes the same scalar.
-    fraction = jnp.mean(onehot, axis=0)
-    mean_prob = jnp.mean(probs, axis=0)
+    # Load-balancing aux (Switch eq. 4) over GLOBAL batch statistics:
+    # fraction_e and mean_prob_e are each pmean'd across devices BEFORE the
+    # product (mean-of-products ≠ product-of-means when routing is skewed
+    # across devices), so the scalar equals the single-device computation on
+    # the gathered batch.
+    fraction = jax.lax.pmean(jnp.mean(onehot, axis=0), axis_name)
+    mean_prob = jax.lax.pmean(jnp.mean(probs, axis=0), axis_name)
     aux = e * jnp.sum(fraction * mean_prob)
-    aux = jax.lax.pmean(aux, axis_name)
 
     # --- dispatch tensors: position of each token within its expert ---
     # (cumsum-1)*onehot is zero at non-assigned entries, so the row sum is

@@ -76,10 +76,12 @@ def row_parallel_dense(x, kernel, bias=None, *, axis_name: str,
         block = x.shape[-1] // p
         x = jax.lax.dynamic_slice_in_dim(x, idx * block, block, axis=x.ndim - 1)
     y = jnp.matmul(x, kernel, preferred_element_type=jnp.float32)
-    y = jax.lax.psum(y.astype(x.dtype), axis_name)
+    # Reduce in fp32: casting the partials to bf16 BEFORE the psum would
+    # accumulate the cross-chip sum at bf16, losing precision with axis size.
+    y = jax.lax.psum(y, axis_name)
     if bias is not None:
         y = y + bias
-    return y
+    return y.astype(x.dtype)
 
 
 def vocab_parallel_embedding(ids, table, *, axis_name: str):

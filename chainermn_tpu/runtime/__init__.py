@@ -19,6 +19,7 @@ never hard-fail.
 from __future__ import annotations
 
 import ctypes
+import hashlib
 import os
 import subprocess
 import tempfile
@@ -34,27 +35,66 @@ _LIB = None
 _LIB_ERR: Optional[str] = None
 
 
+def _user_cache_dir() -> str:
+    """Per-user, owner-only cache dir for the fallback build.
+
+    A fixed world-visible path (e.g. /tmp/_prefetch.so) would let another
+    local user pre-plant a .so that we then CDLL-load in-process (CWE-379);
+    the dir here is keyed on uid, created 0700, and verified to be owned by
+    us and not group/other-writable before anything is loaded from it.
+    """
+    d = os.path.join(tempfile.gettempdir(), f"chainermn-tpu-{os.getuid()}")
+    os.makedirs(d, mode=0o700, exist_ok=True)
+    st = os.stat(d)
+    if st.st_uid != os.getuid() or (st.st_mode & 0o022):
+        raise OSError(f"refusing unsafe native-build cache dir {d}")
+    return d
+
+
 def _build_library() -> Optional[ctypes.CDLL]:
-    """Compile _prefetch.cpp once per interpreter; cache the .so beside the
-    source (falls back to a tempdir when the package dir is read-only)."""
+    """Compile _prefetch.cpp once per interpreter.
+
+    The .so is keyed on a hash of the source (stale binaries are never
+    trusted) and built beside the source, falling back to a per-user 0700
+    cache dir when the package dir is read-only.
+    """
     global _LIB, _LIB_ERR
     with _LIB_LOCK:
         if _LIB is not None or _LIB_ERR is not None:
             return _LIB
-        for out_dir in (_HERE, tempfile.gettempdir()):
-            so_path = os.path.join(out_dir, "_prefetch.so")
+        with open(_SRC, "rb") as f:
+            tag = hashlib.sha256(f.read()).hexdigest()[:16]
+        errs = []
+        for where in ("pkg", "cache"):
             try:
-                if (not os.path.exists(so_path)
-                        or os.path.getmtime(so_path) < os.path.getmtime(_SRC)):
-                    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
-                           "-pthread", _SRC, "-o", so_path]
-                    subprocess.run(cmd, check=True, capture_output=True,
-                                   timeout=120)
+                out_dir = _HERE if where == "pkg" else _user_cache_dir()
+                so_path = os.path.join(out_dir, f"_prefetch-{tag}.so")
+                if not os.path.exists(so_path):
+                    tmp = f"{so_path}.tmp{os.getpid()}"
+                    try:
+                        cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+                               "-pthread", _SRC, "-o", tmp]
+                        subprocess.run(cmd, check=True, capture_output=True,
+                                       timeout=120)
+                        os.replace(tmp, so_path)  # atomic vs other builders
+                    finally:
+                        if os.path.exists(tmp):
+                            os.unlink(tmp)
+                    # GC binaries of older source revisions (and the
+                    # untagged name from pre-hash builds).
+                    for old in os.listdir(out_dir):
+                        if (old.startswith("_prefetch") and old.endswith(".so")
+                                and old != os.path.basename(so_path)):
+                            try:
+                                os.unlink(os.path.join(out_dir, old))
+                            except OSError:
+                                pass
                 _LIB = ctypes.CDLL(so_path)
                 break
             except (OSError, subprocess.SubprocessError) as e:
-                _LIB_ERR = str(e)
+                errs.append(f"{where}: {e}")
         if _LIB is None:
+            _LIB_ERR = "; ".join(errs) or "unknown"
             return None
         _LIB.pfl_create.restype = ctypes.c_void_p
         _LIB.pfl_create.argtypes = [
@@ -63,6 +103,8 @@ def _build_library() -> Optional[ctypes.CDLL]:
         _LIB.pfl_set_order.restype = ctypes.c_int
         _LIB.pfl_set_order.argtypes = [
             ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64), ctypes.c_int64]
+        _LIB.pfl_cancel.restype = ctypes.c_int
+        _LIB.pfl_cancel.argtypes = [ctypes.c_void_p]
         _LIB.pfl_acquire.restype = ctypes.c_int64
         _LIB.pfl_acquire.argtypes = [
             ctypes.c_void_p, ctypes.POINTER(ctypes.c_void_p)]
@@ -291,13 +333,11 @@ class PrefetchIterator:
             self._push_stream()
 
     def _drain(self):
+        """Abandon the in-flight stream in O(1) (pfl_cancel), not O(stream)."""
         self._release_held()
-        out = ctypes.c_void_p()
-        while True:
-            b = self._lib.pfl_acquire(self._handle, ctypes.byref(out))
-            if b < 0:
-                break
-            self._lib.pfl_release(self._handle)
+        rc = self._lib.pfl_cancel(self._handle)
+        if rc != 0:
+            raise RuntimeError("pfl_cancel with a slot still held")
 
     def close(self):
         if getattr(self, "_handle", None):

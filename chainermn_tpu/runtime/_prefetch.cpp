@@ -16,7 +16,8 @@
 //   while ((b = pfl_acquire(h, &p)) >= 0) { consume p; pfl_release(h); }
 //   pfl_destroy(h)
 //
-// Build: g++ -O3 -std=c++17 -shared -fPIC -pthread (see runtime/_build.py).
+// Build: g++ -O3 -std=c++17 -shared -fPIC -pthread (see runtime/__init__.py
+// :: _build_library).
 
 #include <condition_variable>
 #include <cstdint>
@@ -46,6 +47,9 @@ struct Loader {
   int64_t next_build = 0;
   int64_t next_consume = 0;
   int64_t acquired = -1;  // slot index currently held by the consumer
+  int64_t gen = 0;        // stream generation; pfl_cancel bumps it so
+                          // workers parked on a cancelled stream's claims
+                          // drop them instead of filling from a stale order
 
   std::mutex mu;
   std::condition_variable cv_slot_free, cv_batch_ready;
@@ -68,14 +72,17 @@ struct Loader {
       // Claim the next batch of the current stream (park when exhausted).
       while (!stop && next_build >= n_batches) cv_slot_free.wait(lk);
       if (stop) return;
+      int64_t g = gen;
       int64_t b = next_build++;
       // Turn gate: fill only once the slot's previous occupant (batch
       // b - n_slots) has been CONSUMED.  A bare slot.consumed check is
       // racy — the worker holding batch b+n_slots could steal the slot
       // the moment the consumer frees it, deadlocking batch b.
       Slot& slot = slots[b % n_slots];
-      while (!stop && next_consume + n_slots <= b) cv_slot_free.wait(lk);
+      while (!stop && gen == g && next_consume + n_slots <= b)
+        cv_slot_free.wait(lk);
       if (stop) return;
+      if (gen != g) continue;  // stream cancelled while parked: drop claim
       slot.consumed = false;
       slot.batch = -1;  // mark "filling"
       ++filling;
@@ -83,7 +90,7 @@ struct Loader {
       fill(b, slot);    // the GIL-free hot copy, outside the lock
       lk.lock();
       --filling;
-      slot.batch = b;
+      if (gen == g) slot.batch = b;  // publish only into the same stream
       cv_batch_ready.notify_all();
     }
   }
@@ -117,12 +124,14 @@ int pfl_cancel(void* h) {
   auto* L = static_cast<Loader*>(h);
   std::unique_lock<std::mutex> lk(L->mu);
   if (L->acquired >= 0) return -1;
+  ++L->gen;           // invalidates every outstanding claim
   L->n_batches = 0;   // parks claim loops immediately
   L->next_build = 0;
   L->next_consume = 0;
+  L->cv_slot_free.notify_all();  // wake gate-parked workers to drop claims
   while (L->filling > 0) {
-    // Workers mid-copy finish into their slot and publish; the ring reset
-    // below discards it.  cv_batch_ready fires exactly on that publish.
+    // Workers mid-copy finish into their slot but skip the publish (gen
+    // mismatch); cv_batch_ready fires exactly on that finish.
     L->cv_batch_ready.wait(lk);
   }
   for (auto& s : L->slots) { s.batch = -1; s.consumed = true; }

@@ -37,6 +37,10 @@ def main():
     parser.add_argument("--momentum", type=float, default=0.9)
     parser.add_argument("--weight-decay", type=float, default=1e-4)
     parser.add_argument("--double-buffering", action="store_true")
+    parser.add_argument("--allreduce-grad-dtype", default=None,
+                        choices=["bfloat16", "float16", "float32"],
+                        help="wire dtype for the cross-chip gradient mean "
+                             "(reference: pure_nccl allreduce_grad_dtype)")
     parser.add_argument("--communicator", default="xla")
     args = parser.parse_args()
 
@@ -76,7 +80,8 @@ def main():
             optax.add_decayed_weights(args.weight_decay),
             optax.sgd(args.lr, momentum=args.momentum),
         ),
-        comm, double_buffering=args.double_buffering)
+        comm, double_buffering=args.double_buffering,
+        allreduce_grad_dtype=args.allreduce_grad_dtype)
 
     def loss_and_metrics(logits, batch):
         _, labels = batch
@@ -84,7 +89,9 @@ def main():
         acc = (logits.argmax(-1) == labels).mean()
         return loss, {"accuracy": acc}
 
-    step = mn.make_flax_train_step(model, loss_and_metrics, optimizer, mesh=mesh)
+    step = mn.make_flax_train_step(
+        model, loss_and_metrics, optimizer, mesh=mesh,
+        allreduce_grad_dtype=args.allreduce_grad_dtype)
     variables = mn.replicate(dict(variables), mesh)
     opt_state = mn.replicate(optimizer.init(variables["params"]), mesh)
 

@@ -49,6 +49,35 @@ class TestForward:
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    rtol=2e-4, atol=2e-5)
 
+    @pytest.mark.parametrize("causal", [False, True])
+    @pytest.mark.parametrize("s", [97, 130])  # prime / small-factor lengths
+    def test_pad_and_mask_awkward_seq_len(self, causal, s):
+        """S with tiny divisors pads up to the block and masks the tail
+        instead of degrading to Mosaic-hostile size-1 blocks."""
+        q, k, v = qkv(s=s)
+        got = flash_attention(q, k, v, causal=causal, block_q=32, block_k=32)
+        assert got.shape == q.shape
+        want = reference(q, k, v, causal)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_pad_and_mask_gradients(self):
+        q, k, v = qkv(s=97)
+
+        def loss_flash(q, k, v):
+            return (flash_attention(q, k, v, causal=True,
+                                    block_q=32, block_k=32) ** 2).sum()
+
+        def loss_ref(q, k, v):
+            return (reference(q, k, v, causal=True) ** 2).sum()
+
+        got = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        want = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for g, w in zip(got, want):
+            assert np.all(np.isfinite(np.asarray(g)))
+            np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                       rtol=2e-3, atol=2e-4)
+
     def test_bf16(self):
         q, k, v = (jnp.asarray(x, jnp.bfloat16) for x in qkv(seed=1))
         got = flash_attention(q, k, v, block_q=32, block_k=32)
