@@ -48,6 +48,7 @@ from .optimizers import (  # noqa: F401
     compressed_mean,
     create_multi_node_optimizer,
     gradient_average,
+    hierarchical_gradient_average,
 )
 from .train import (  # noqa: F401
     make_flax_train_step,
@@ -66,6 +67,7 @@ from .topology import (  # noqa: F401
     Topology,
     init_distributed,
     make_mesh,
+    make_multislice_mesh,
     make_nd_mesh,
 )
 

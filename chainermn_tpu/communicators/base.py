@@ -120,6 +120,15 @@ class CommunicatorBase:
     def recv_obj(self, source: int) -> Any:
         raise NotImplementedError
 
+    # ---- placement ----
+    def device_of(self, rank: int):
+        """The chip that owns ``rank``, or None when the communicator has no
+        physical devices (the naive loopback).  Consumers
+        (``MultiNodeChainList``) use it to pin per-rank state and emit real
+        cross-chip copies — the reference's "rank → intra_rank-th GPU"
+        binding (SURVEY.md §1)."""
+        return None
+
     # ---- model helpers ----
     def broadcast_data(self, params):
         """Replicate a parameter pytree to every chip (reference:

@@ -94,6 +94,9 @@ class XlaCommunicator(CommunicatorBase):
     def owns_rank(self, r: int) -> bool:
         return self._devices[r].process_index == jax.process_index()
 
+    def device_of(self, rank: int):
+        return self._devices[rank]
+
     # ---- compiled-program cache ----
     def _program(self, key, fn, in_specs=None, out_specs=None):
         if key not in self._progs:

@@ -6,17 +6,26 @@ reference registers sub-chains annotated with ``rank_in``/``rank_out``;
 forward interleaves blocking MPI ``recv → chain → send`` with
 pseudo_connect threading, and autograd replays the messages in reverse.
 
-TPU-native (single-controller): the whole graph traces into ONE
-differentiable jitted program — stage boundaries are data edges, not
-blocking messages, so "autograd across the process boundary" (the
-reference's hard part, §3.5) is just autodiff.  Routing is logical: this
-container preserves the reference's message-passing semantics; *physical*
-placement comes from the shardings of the enclosing jit (pin stage params
-with device_put/shardings at the top level), and the high-throughput
-microbatched SPMD pipeline lives in ``chainermn_tpu.parallel.pipeline``
-(the reference had no schedule at all — SURVEY.md §2.8 "PP: absent").
-The message routing table (who consumes whose output) is exactly the
-reference's:
+TPU-native (single-controller), two execution faces:
+
+* **Eager (placed)** — the default, closest to the reference's execution
+  model: each stage's params are pinned to its rank's chip at
+  registration (``device_put``), ``_to_rank`` edges are real cross-chip
+  copies (ICI transfers), and each stage's compute runs on its own chip
+  because its operands are committed there.  Still differentiable
+  end-to-end — ``jax.grad`` replays the transfers in reverse
+  (``device_put``'s transpose moves the cotangent back), which is the
+  reference's "autograd crosses process boundaries" (§3.5) for free.
+* **Traced (fused)** — call the instance inside ``jax.jit``: the graph
+  becomes one differentiable program, routing stays logical, and XLA
+  places the fused program (in-jit ``device_put`` is a scheduling hint at
+  best).  Use this when single-executable fusion matters more than
+  explicit placement.
+
+The high-throughput microbatched SPMD pipeline lives in
+``chainermn_tpu.parallel.pipeline`` (the reference had no schedule at all —
+SURVEY.md §2.8 "PP: absent").  The message routing table (who consumes
+whose output) is exactly the reference's:
 
 * ``rank_in=None``  → stage consumes the model input ``x``
 * ``rank_in=r``     → stage consumes the pending message addressed to its
@@ -65,19 +74,38 @@ class MultiNodeChainList:
                  rank_in: Rank = None, rank_out: Rank = None) -> None:
         if not 0 <= rank < self._comm.size:
             raise ValueError(f"rank {rank} out of range for size {self._comm.size}")
+        device = self._comm.device_of(rank)
+        if device is not None:
+            # Pin the stage's params to its chip — with its operands
+            # committed there, the stage's compute lands on that chip
+            # (reference: "rank → intra_rank-th GPU" placement, SURVEY.md §1).
+            params = jax.device_put(params, device)
         self._stages.append(_Stage(apply_fn, params, rank, rank_in, rank_out))
 
     def _to_rank(self, value, rank: int):
-        """The logical transfer edge rank→rank.  Placement is decided by the
-        enclosing jit's shardings; inside the traced program this edge is
-        where XLA emits the ICI copy when stages are pinned to chips."""
-        del rank
-        return value
+        """The transfer edge →rank.  Eager: a real cross-chip copy (ICI)
+        committing ``value`` to rank's chip, differentiable (the transpose
+        copies the cotangent back).  Inside jit (tracing): a no-op hint —
+        the fused program's placement belongs to XLA."""
+        device = self._comm.device_of(rank)
+        if device is None:
+            return value
+        return jax.device_put(value, device)
 
-    def params(self) -> List[Any]:
+    def params(self, placed: bool = True) -> List[Any]:
         """Per-stage parameter pytrees (differentiable argument list for
-        ``__call__(x, params=...)``)."""
-        return [s.params for s in self._stages]
+        ``__call__(x, params=...)``).
+
+        ``placed=True`` (default): each stage's pytree stays committed to
+        its rank's chip — feed the eager placed face.  ``placed=False``:
+        uncommitted host copies — required when the whole list is an
+        argument of ONE fused ``jax.jit`` (jit rejects arguments committed
+        to different chips; the fused program's placement belongs to XLA).
+        """
+        if placed:
+            return [s.params for s in self._stages]
+        return [jax.tree_util.tree_map(lambda v: jax.device_get(v), s.params)
+                for s in self._stages]
 
     def __call__(self, x, params: Optional[List[Any]] = None):
         """Run the graph.  ``params`` overrides stage parameters (so the

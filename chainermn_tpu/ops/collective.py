@@ -24,15 +24,18 @@ import jax
 from ..topology import DEFAULT_AXIS_NAME
 
 
-def _axis_bound(axis_name: str) -> bool:
-    """True when `axis_name` is a bound SPMD axis in the current trace.
+def _axis_bound(axis_name) -> bool:
+    """True when `axis_name` (a name or tuple of names) is bound in the
+    current trace.
 
     Only the unbound-axis error (NameError in current JAX) means "not SPMD";
     anything else propagates — silently treating an unexpected failure as
     unbound would turn gradient averaging into identity and corrupt training.
     """
+    names = axis_name if isinstance(axis_name, (tuple, list)) else (axis_name,)
     try:
-        jax.lax.axis_index(axis_name)
+        for name in names:
+            jax.lax.axis_index(name)
         return True
     except NameError:
         return False
@@ -107,4 +110,35 @@ def bcast(x, root: int = 0, axis_name: str = DEFAULT_AXIS_NAME):
     def one(v):
         g = jax.lax.all_gather(v, axis_name, axis=0, tiled=False)
         return g[root]
+    return jax.tree_util.tree_map(one, x)
+
+
+def hierarchical_pmean(x, chip_axis: str = "chip", slice_axis: str = "slice",
+                       dcn_dtype=None):
+    """Two-tier mean over a ``('slice', 'chip')`` multislice mesh.
+
+    Reference analog: ``HierarchicalCommunicator`` [uv] (SURVEY.md §2.1) —
+    reduce on the fast fabric first (intra-node NCCL), cross the slow one
+    once (inter-node MPI).  TPU: mean over ``chip_axis`` rides ICI inside
+    each slice; the already-reduced value then crosses DCN exactly once via
+    the ``slice_axis`` mean.  The decomposition mean = mean_slice(mean_chip)
+    is exact (equal slice sizes by mesh construction).
+
+    ``dcn_dtype`` (e.g. ``'bfloat16'``) compresses ONLY the DCN leg — the
+    two-tier version of the reference's fp16 allreduce: ICI is fast enough
+    for fp32, the cross-slice hop is the bottleneck worth halving.
+
+    Mesh recipe: ``topology.make_multislice_mesh()``; call this under
+    ``shard_map`` with both axes bound (in place of the flat gradient
+    pmean).  :func:`chainermn_tpu.optimizers.hierarchical_gradient_average`
+    packages it as an optax transform.
+    """
+    import jax.numpy as jnp
+
+    def one(v):
+        local = jax.lax.pmean(v, chip_axis)           # ICI, within slice
+        if dcn_dtype is not None:
+            wire = jnp.dtype(dcn_dtype)
+            return jax.lax.pmean(local.astype(wire), slice_axis).astype(v.dtype)
+        return jax.lax.pmean(local, slice_axis)       # DCN, once
     return jax.tree_util.tree_map(one, x)

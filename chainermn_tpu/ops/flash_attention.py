@@ -175,9 +175,16 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret, seq_len):
     return out, lse[..., 0]
 
 
-def _bwd_blockwise(q, k, v, out, lse, do, causal, scale, block_k, seq_len):
+def _bwd_blockwise(q, k, v, out, lse, do, causal, scale, block_k, seq_len,
+                   dlse=None):
     """Memory-efficient backward: scan over K blocks, recomputing p from
-    the saved LSE.  All operands (BH, S, D); returns (dq, dk, dv)."""
+    the saved LSE.  All operands (BH, S, D); returns (dq, dk, dv).
+
+    ``dlse``: cotangent of the LSE output when the caller differentiates
+    through it (ring attention's block-merge weights).  Since
+    ∂lse_i/∂s_ij = p_ij, it folds into the score cotangent as
+    ``ds = p * (dp - delta + dlse)``; v gets no extra term (lse is
+    v-independent)."""
     bh, s, d = q.shape
     bk = _pick_block(s, block_k)
     nk = s // bk
@@ -206,7 +213,10 @@ def _bwd_blockwise(q, k, v, out, lse, do, causal, scale, block_k, seq_len):
                           preferred_element_type=jnp.float32)
         dp = jnp.einsum("bqd,bkd->bqk", do, vb,
                         preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[..., None]) * scale              # (BH, S, bk)
+        dsoft = dp - delta[..., None]
+        if dlse is not None:
+            dsoft = dsoft + dlse[..., None]
+        ds = p * dsoft * scale                                # (BH, S, bk)
         dq_acc = dq_acc + jnp.einsum("bqk,bkd->bqd", ds.astype(kb.dtype), kb,
                                      preferred_element_type=jnp.float32)
         dk_b = jnp.einsum("bqk,bqd->bkd", ds.astype(q.dtype), q,
@@ -246,8 +256,37 @@ def _flash_bhsd_bwd(causal, block_q, block_k, interpret, seq_len, res, do):
 _flash_bhsd.defvjp(_flash_bhsd_fwd, _flash_bhsd_bwd)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_bhsd_lse(q, k, v, causal, block_q, block_k, interpret, seq_len):
+    """Like :func:`_flash_bhsd` but also returns the LSE as a DIFFERENTIABLE
+    output — ring attention merges visiting blocks with LSE-derived weights,
+    so gradients must flow through it."""
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    return _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
+                      seq_len)
+
+
+def _flash_bhsd_lse_fwd(q, k, v, causal, block_q, block_k, interpret, seq_len):
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    out, lse = _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
+                          seq_len)
+    return (out, lse), (q, k, v, out, lse)
+
+
+def _flash_bhsd_lse_bwd(causal, block_q, block_k, interpret, seq_len, res, cts):
+    q, k, v, out, lse = res
+    do, dlse = cts
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    return _bwd_blockwise(q, k, v, out, lse, do, causal, scale, block_k,
+                          seq_len, dlse=dlse)
+
+
+_flash_bhsd_lse.defvjp(_flash_bhsd_lse_fwd, _flash_bhsd_lse_bwd)
+
+
 def flash_attention(q, k, v, causal: bool = False, block_q: int = 128,
-                    block_k: int = 128, interpret: Optional[bool] = None):
+                    block_k: int = 128, interpret: Optional[bool] = None,
+                    return_lse: bool = False):
     """Flash attention over ``(B, S, H, D)`` arrays.
 
     ``interpret=None`` auto-selects: the compiled Pallas kernel on TPU,
@@ -257,6 +296,10 @@ def flash_attention(q, k, v, causal: bool = False, block_q: int = 128,
     degrade to Mosaic-hostile tiny blocks) ``S`` is padded up to the block
     size and the tail masked inside the kernel.  Differentiable via the
     blockwise LSE backward; O(S·block) live memory both directions.
+
+    ``return_lse=True`` additionally returns the per-query log-sum-exp
+    ``(B, H, S)`` as a differentiable output (the block-merge currency of
+    ring attention).
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
@@ -271,6 +314,11 @@ def flash_attention(q, k, v, causal: bool = False, block_q: int = 128,
     def to_bhsd(x):
         return x.transpose(0, 2, 1, 3).reshape(b * h, s_pad, x.shape[-1])
 
+    if return_lse:
+        out, lse = _flash_bhsd_lse(to_bhsd(q), to_bhsd(k), to_bhsd(v),
+                                   causal, block_q, block_k, interpret, s)
+        return (out.reshape(b, h, s_pad, d)[:, :, :s].transpose(0, 2, 1, 3),
+                lse.reshape(b, h, s_pad)[:, :, :s])
     out = _flash_bhsd(to_bhsd(q), to_bhsd(k), to_bhsd(v),
                       causal, block_q, block_k, interpret, s)
     return out.reshape(b, h, s_pad, d)[:, :, :s].transpose(0, 2, 1, 3)

@@ -41,14 +41,14 @@ import jax.numpy as jnp
 import optax
 
 from .communicators.base import CommunicatorBase
-from .ops.collective import pmean_if_bound
+from .ops.collective import _axis_bound, pmean, pmean_if_bound
 from .topology import DEFAULT_AXIS_NAME
 
 
 def _resolve_axis(communicator: Union[CommunicatorBase, str, None]) -> Optional[str]:
     if communicator is None:
         return DEFAULT_AXIS_NAME
-    if isinstance(communicator, str):
+    if isinstance(communicator, (str, tuple, list)):
         return communicator
     return getattr(communicator, "axis_name", DEFAULT_AXIS_NAME)
 
@@ -99,6 +99,43 @@ def gradient_average(communicator=None, allreduce_grad_dtype=None) -> optax.Grad
     def update_fn(updates, state, params=None):
         del params
         return compressed_mean(updates, axis_name, allreduce_grad_dtype), state
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def hierarchical_gradient_average(chip_axis: str = "chip",
+                                  slice_axis: str = "slice",
+                                  dcn_dtype=None) -> optax.GradientTransformation:
+    """Two-tier gradient mean over a multislice ``('slice','chip')`` mesh.
+
+    Reference analog: ``HierarchicalCommunicator`` [uv] — the fast-fabric-
+    first allreduce, rebuilt for ICI×DCN (see
+    :func:`chainermn_tpu.ops.collective.hierarchical_pmean`; mesh from
+    :func:`chainermn_tpu.topology.make_multislice_mesh`).  ``dcn_dtype``
+    compresses only the cross-slice leg.  Feed the train-step builder
+    local (varying) gradients — e.g. via ``make_train_step(...,
+    grad_reduce=...)`` — so this transform's collectives are the wire ops.
+    """
+    from .ops.collective import hierarchical_pmean
+
+    def init_fn(params):
+        del params
+        return optax.EmptyState()
+
+    def update_fn(updates, state, params=None):
+        del params
+        chip, slc = _axis_bound(chip_axis), _axis_bound(slice_axis)
+        if chip and slc:
+            updates = hierarchical_pmean(updates, chip_axis, slice_axis, dcn_dtype)
+        elif chip:
+            # Single-slice run (no slice axis in the mesh): the ICI mean is
+            # still mandatory — skipping reduction entirely here would
+            # silently diverge per-rank params.
+            updates = pmean(updates, chip_axis)
+        elif slc:
+            # Degenerate one-chip-per-slice mesh: only the DCN leg exists.
+            updates = compressed_mean(updates, slice_axis, dcn_dtype)
+        return updates, state
 
     return optax.GradientTransformation(init_fn, update_fn)
 

@@ -28,6 +28,12 @@ from .pipeline import (  # noqa: F401
     pipeline_apply,
     stack_stage_params,
 )
+from .hybrid import (  # noqa: F401
+    make_hybrid_shard_map_step,
+    make_hybrid_train_step,
+    shard_pytree,
+    state_specs_like,
+)
 from .tensor_parallel import (  # noqa: F401
     column_parallel_dense,
     init_tp_mlp_params,
@@ -57,4 +63,8 @@ __all__ = [
     "init_tp_mlp_params",
     "tp_mlp_specs",
     "make_tensor_parallel_mlp",
+    "make_hybrid_train_step",
+    "make_hybrid_shard_map_step",
+    "shard_pytree",
+    "state_specs_like",
 ]

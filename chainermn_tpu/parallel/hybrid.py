@@ -1,0 +1,161 @@
+"""Hybrid data x model parallelism: one jitted step over an N-D mesh.
+
+Reference parity: SURVEY.md §2.8 "Hybrid DP×MP" — the reference composed
+2-D layouts by hand from ``CommunicatorBase.split(color, key)``
+sub-communicators (``communicator_base.py :: split`` [uv]) and the
+``examples/model_parallel`` graphs [uv]: a data-parallel allreduce among
+same-position ranks x an activation pipeline among same-replica ranks.
+
+TPU-native there are two faces, both over one :func:`topology.make_nd_mesh`
+``('data', 'model')`` mesh:
+
+* **pjit face** (:func:`make_hybrid_train_step`) — the idiomatic one.
+  Params are placed with per-leaf ``NamedSharding`` (model-dim sharded,
+  data-replicated; see :func:`shard_pytree`), the batch is sharded over
+  ``'data'``, and the step is a *plain* ``jax.jit``: XLA's sharding
+  propagation (GSPMD) inserts the TP psums/all-gathers AND the DP gradient
+  reduce-scatter from the shardings alone — the scaling-book recipe ("pick
+  a mesh, annotate shardings, let XLA insert collectives").
+* **shard_map face** (:func:`make_hybrid_shard_map_step`) — the explicit
+  one, for models written against ``parallel.tensor_parallel``'s per-rank
+  layers: both axes are bound, TP layers psum over ``'model'`` themselves,
+  and the loss is pmean'd over ``'data'`` so autodiff inserts the DP
+  gradient reduction exactly like the 1-D :func:`train.make_train_step`.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import optax
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def shard_pytree(tree, mesh: Mesh, specs):
+    """Place ``tree`` on ``mesh`` with a matching pytree of PartitionSpecs.
+
+    ``specs`` may be a single spec (applied to every leaf) or a pytree
+    matching ``tree``'s structure.
+    """
+    if isinstance(specs, P):
+        specs = jax.tree_util.tree_map(lambda _: specs, tree)
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), tree, specs)
+
+
+def make_hybrid_train_step(
+    loss_fn: Callable,
+    optimizer: optax.GradientTransformation,
+    has_aux: bool = False,
+    donate: bool = True,
+):
+    """Hybrid-parallel train step, pjit face.
+
+    ``loss_fn(params, batch)`` is written over the GLOBAL logical batch
+    (plain jnp ops; sprinkle ``jax.lax.with_sharding_constraint`` on
+    activations to pin layouts).  Parallelism comes entirely from the
+    shardings the caller placed on ``params`` (via :func:`shard_pytree`)
+    and ``batch`` — XLA derives the TP collectives and the DP gradient
+    reduction, so the same step runs 1-D DP, 1-D TP, or 2-D DP×TP
+    depending only on how the arrays are laid out.
+
+    ``opt_state`` should be created with ``jax.jit(optimizer.init)(params)``
+    so its shardings are inferred to follow the params.
+    """
+
+    def step(params, opt_state, batch):
+        def global_loss(p):
+            out = loss_fn(p, batch)
+            if has_aux:
+                return out
+            return out, None
+
+        (loss, aux), grads = jax.value_and_grad(global_loss, has_aux=True)(params)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        if has_aux:
+            return params, opt_state, loss, aux
+        return params, opt_state, loss
+
+    return jax.jit(step, donate_argnums=(0, 1) if donate else ())
+
+
+def state_specs_like(optimizer: optax.GradientTransformation, params,
+                     param_specs):
+    """PartitionSpecs for ``optimizer.init(params)``'s state pytree.
+
+    Optax states nest sub-pytrees structurally identical to ``params``
+    (momentum/trace, Adam's mu/nu); each such subtree inherits
+    ``param_specs`` wholesale, every other leaf (step counts, scalars) is
+    replicated.  This is what lets the shard_map face wrap arbitrary optax
+    optimizers without per-optimizer spec plumbing.
+    """
+    state = jax.eval_shape(optimizer.init, params)
+    pdef = jax.tree_util.tree_structure(params)
+
+    def params_like(node):
+        try:
+            return jax.tree_util.tree_structure(node) == pdef
+        except Exception:
+            return False
+
+    return jax.tree_util.tree_map(
+        lambda sub: (param_specs if params_like(sub)
+                     else jax.tree_util.tree_map(lambda _: P(), sub)),
+        state, is_leaf=params_like)
+
+
+def make_hybrid_shard_map_step(
+    loss_fn: Callable,
+    optimizer: optax.GradientTransformation,
+    mesh: Mesh,
+    params,
+    param_specs,
+    data_axis: str = "data",
+    batch_spec: Optional[P] = None,
+    has_aux: bool = False,
+    donate: bool = True,
+):
+    """Hybrid-parallel train step, explicit shard_map face.
+
+    ``loss_fn(params, local_batch)`` runs with BOTH mesh axes bound: TP
+    layers (``parallel.tensor_parallel``) psum over the model axis
+    themselves; this builder pmeans the loss over ``data_axis`` so autodiff
+    inserts the cross-replica gradient reduction (and ONLY that — params
+    varying over the model axis get no spurious model-axis psum).
+
+    ``params``/``param_specs``: the TP layout (e.g. ``wi`` sharded on its
+    output dim over ``'model'``); used to derive optimizer-state specs via
+    :func:`state_specs_like`.  ``batch_spec`` defaults to sharding the
+    leading axis over ``data_axis``.
+    """
+    if batch_spec is None:
+        batch_spec = P(data_axis)
+    st_specs = state_specs_like(optimizer, params, param_specs)
+
+    def spmd(params, opt_state, batch):
+        def global_loss(p):
+            out = loss_fn(p, batch)
+            if has_aux:
+                local, aux = out
+            else:
+                local, aux = out, None
+            return jax.lax.pmean(local, data_axis), aux
+
+        (loss, aux), grads = jax.value_and_grad(global_loss, has_aux=True)(params)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        if has_aux:
+            return params, opt_state, loss, jax.lax.pmean(aux, data_axis)
+        return params, opt_state, loss
+
+    out_specs = ((param_specs, st_specs, P(), P()) if has_aux
+                 else (param_specs, st_specs, P()))
+    smapped = shard_map(
+        spmd, mesh=mesh,
+        in_specs=(param_specs, st_specs, batch_spec),
+        out_specs=out_specs,
+    )
+    return jax.jit(smapped, donate_argnums=(0, 1) if donate else ())

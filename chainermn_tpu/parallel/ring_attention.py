@@ -43,15 +43,81 @@ def _block_scores(q, k, scale):
         preferred_element_type=jnp.float32) * scale
 
 
+def _ring_flash(q, k, v, axis_name, causal):
+    """Ring attention with the Pallas flash kernel as the local block
+    compute: the O(Sq·Sk) per-block score matrix never materializes — the
+    kernel streams MXU tiles through VMEM and hands back ``(out, lse)``,
+    and visiting blocks merge through the numerically-exact log-sum-exp
+    recurrence.  Gradients flow through the merge weights via the kernel's
+    differentiable LSE output."""
+    from ..ops.flash_attention import flash_attention
+
+    p_size = jax.lax.psum(1, axis_name)
+    my = jax.lax.axis_index(axis_name)
+
+    def local(k_blk, v_blk, blk_causal):
+        return flash_attention(q, k_blk, v_blk, causal=blk_causal,
+                               return_lse=True)
+
+    def step(carry, t):
+        k_blk, v_blk, o, lse = carry
+        src = (my - t) % p_size  # who this block originally belonged to
+        if causal:
+            # src < my: every key precedes every query (full block);
+            # src == my: the diagonal (causal within the block);
+            # src > my: entirely in the future (contributes nothing).
+            def full(_):
+                return local(k_blk, v_blk, False)
+
+            def diag(_):
+                return local(k_blk, v_blk, True)
+
+            def skip(_):
+                return (jnp.zeros_like(o).astype(q.dtype),
+                        jnp.full(lse.shape, _NEG_INF, jnp.float32))
+
+            idx = jnp.where(src < my, 0, jnp.where(src == my, 1, 2))
+            out_t, lse_t = jax.lax.switch(idx, [full, diag, skip], None)
+        else:
+            out_t, lse_t = local(k_blk, v_blk, False)
+
+        # LSE-weighted merge; _NEG_INF is finite so empty accumulators and
+        # fully-masked blocks contribute exact zeros, never NaNs.
+        lse_new = jnp.logaddexp(lse, lse_t)                  # (B, H, Sq)
+        w_old = jnp.exp(lse - lse_new).transpose(0, 2, 1)[..., None]
+        w_new = jnp.exp(lse_t - lse_new).transpose(0, 2, 1)[..., None]
+        o = o * w_old + out_t.astype(jnp.float32) * w_new    # (B, Sq, H, D)
+
+        perm = [(i, (i + 1) % p_size) for i in range(p_size)]
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        return (k_blk, v_blk, o, lse_new), None
+
+    o0 = q.astype(jnp.float32) * 0                           # (B, Sq, H, D)
+    lse0 = jnp.swapaxes(o0[..., 0], 1, 2) + _NEG_INF         # (B, H, Sq)
+    (_, _, o, _), _ = jax.lax.scan(step, (k, v, o0, lse0), jnp.arange(p_size))
+    return o.astype(q.dtype)
+
+
 def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
-                   axis_name: str, causal: bool = False) -> jnp.ndarray:
+                   axis_name: str, causal: bool = False,
+                   attn_impl: str = "xla") -> jnp.ndarray:
     """Exact multi-head attention over a sequence-sharded axis.
 
     Call INSIDE ``shard_map``: ``q,k,v`` are the local shards, shape
     ``(batch, seq_local, heads, head_dim)``; the global sequence is
     ``seq_local * axis_size`` in rank order along ``axis_name``.  Returns
     the local output shard, same shape/dtype as ``q``.
+
+    ``attn_impl``: ``'xla'`` materializes each visiting block's
+    ``(B, H, Sq, Sk)`` score matrix (fine at short S); ``'flash'`` runs the
+    Pallas kernel per block — O(block) live memory, the long-context
+    configuration.
     """
+    if attn_impl == "flash":
+        return _ring_flash(q, k, v, axis_name, causal)
+    if attn_impl != "xla":
+        raise ValueError(f"attn_impl must be 'xla' or 'flash', got {attn_impl!r}")
     p_size = jax.lax.psum(1, axis_name)
     my = jax.lax.axis_index(axis_name)
     b, s_q, h, d = q.shape
@@ -101,7 +167,15 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
 
 def make_ring_attention(mesh: Optional[Mesh] = None,
                         axis_name: Optional[str] = None,
-                        causal: bool = False):
+                        causal: bool = False, attn_impl: str = "xla"):
     """Eager/jit face over GLOBAL sequence-sharded arrays (see
     ``_factory.make_sp_attention``)."""
-    return make_sp_attention(ring_attention, mesh, axis_name, causal)
+    from functools import partial
+
+    # Same caveat as make_ulysses_attention: interpreted (CPU) pallas can't
+    # propagate varying-axes; the compiled TPU path keeps the check.
+    interpreted_flash = (attn_impl == "flash"
+                         and jax.default_backend() != "tpu")
+    return make_sp_attention(
+        partial(ring_attention, attn_impl=attn_impl),
+        mesh, axis_name, causal, check_vma=not interpreted_flash)

@@ -126,3 +126,57 @@ def make_nd_mesh(axis_names: Sequence[str], axis_sizes: Sequence[int],
     devices = list(devices) if devices is not None else jax.devices()
     arr = np.asarray(devices, dtype=object).reshape(tuple(axis_sizes))
     return Mesh(arr, tuple(axis_names))
+
+
+def slice_index_of(device: jax.Device) -> int:
+    """Which slice (ICI island) a device belongs to.
+
+    Real multislice TPU devices carry ``slice_index``; single-slice and CPU
+    devices fall back to ``process_index`` (each host = one "slice", the
+    closest analog: intra-host is fast, cross-host is DCN).
+    """
+    idx = getattr(device, "slice_index", None)
+    if idx is not None:
+        return int(idx)
+    return int(device.process_index)
+
+
+def make_multislice_mesh(
+    devices: Optional[Sequence[jax.Device]] = None,
+    axis_names: Sequence[str] = ("slice", "chip"),
+    num_slices: Optional[int] = None,
+) -> Mesh:
+    """A 2-D ``('slice', 'chip')`` mesh exposing the two-tier fabric.
+
+    Reference analog: ``HierarchicalCommunicator`` [uv] — intra-node NCCL
+    reduce → inter-node MPI allreduce → intra-node bcast, i.e. "use the
+    fast fabric first, cross the slow one once".  On TPU the two tiers are
+    ICI (within a slice) and DCN (across slices); collectives over the
+    ``chip`` axis ride ICI, collectives over ``slice`` cross DCN.  See
+    :func:`chainermn_tpu.ops.collective.hierarchical_pmean` for the
+    gradient-mean recipe built on this mesh.
+
+    Slice membership comes from each device's ``slice_index`` (multislice
+    runtime) with a ``process_index`` fallback; ``num_slices`` overrides
+    detection (e.g. to carve a virtual CPU mesh into fake slices for tests).
+    """
+    devices = list(devices) if devices is not None else jax.devices()
+    if num_slices is None:
+        groups: dict = {}
+        for d in devices:
+            groups.setdefault(slice_index_of(d), []).append(d)
+        sizes = {len(v) for v in groups.values()}
+        if len(sizes) != 1:
+            raise ValueError(
+                f"uneven slices: {{idx: len}} = "
+                f"{ {k: len(v) for k, v in groups.items()} }")
+        ordered = [d for _, grp in sorted(groups.items()) for d in grp]
+        num_slices = len(groups)
+    else:
+        if len(devices) % num_slices:
+            raise ValueError(
+                f"{len(devices)} devices not divisible into {num_slices} slices")
+        ordered = devices
+    arr = np.asarray(ordered, dtype=object).reshape(
+        (num_slices, len(ordered) // num_slices))
+    return Mesh(arr, tuple(axis_names))

@@ -23,7 +23,8 @@ from .optimizers import compressed_mean
 from .topology import DEFAULT_AXIS_NAME, make_mesh
 
 
-def _value_and_global_grads(local_loss, params, axis_name, allreduce_grad_dtype):
+def _value_and_global_grads(local_loss, params, axis_name,
+                            allreduce_grad_dtype, grad_reduce=None):
     """``((loss, aux), grads)`` with the cross-rank gradient mean done right.
 
     Default path: differentiate the GLOBAL mean loss (pmean over ranks of
@@ -39,8 +40,13 @@ def _value_and_global_grads(local_loss, params, axis_name, allreduce_grad_dtype)
     psum); the explicit :func:`compressed_mean` is then the one wire
     collective, in the reduced dtype.  ``local_loss(p)`` must return
     ``(loss, aux)``.
+
+    ``grad_reduce`` replaces :func:`compressed_mean` entirely (same
+    local-grad derivation): a ``grads -> grads`` callable owning the wire
+    collective — e.g. ``ops.collective.hierarchical_pmean`` for the
+    two-tier ICI×DCN mean over a multislice mesh.
     """
-    if allreduce_grad_dtype is None:
+    if allreduce_grad_dtype is None and grad_reduce is None:
         def global_loss(p):
             loss, aux = local_loss(p)
             return jax.lax.pmean(loss, axis_name), aux
@@ -50,7 +56,10 @@ def _value_and_global_grads(local_loss, params, axis_name, allreduce_grad_dtype)
     p_local = jax.tree_util.tree_map(
         lambda v: jax.lax.pcast(v, axis_name, to="varying"), params)
     (loss, aux), grads = jax.value_and_grad(local_loss, has_aux=True)(p_local)
-    grads = compressed_mean(grads, axis_name, allreduce_grad_dtype)
+    if grad_reduce is not None:
+        grads = grad_reduce(grads)
+    else:
+        grads = compressed_mean(grads, axis_name, allreduce_grad_dtype)
     return (jax.lax.pmean(loss, axis_name), aux), grads
 
 
@@ -62,6 +71,7 @@ def make_train_step(
     has_aux: bool = False,
     donate: bool = True,
     allreduce_grad_dtype=None,
+    grad_reduce: Optional[Callable] = None,
 ):
     """Build ``step(params, opt_state, batch) -> (params, opt_state, loss[, aux])``.
 
@@ -90,7 +100,7 @@ def make_train_step(
             return out, None
 
         (loss, aux), grads = _value_and_global_grads(
-            local_loss, params, axis_name, allreduce_grad_dtype)
+            local_loss, params, axis_name, allreduce_grad_dtype, grad_reduce)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         if has_aux:

@@ -116,6 +116,98 @@ class PrintReport:
         self._printed = len(self.log_report.log)
 
 
+class StepTimer:
+    """Per-step wall time (s) into ``observation['time/step']``.
+
+    SURVEY.md §5: the reference had no in-tree profiling (Chainer TimerHook
+    + nvprof externally); the rebuild ships per-step timing as a first-class
+    extension.  LogReport folds the value into epoch means, giving
+    throughput directly from the training log.  Priority above the writers
+    so the stamp lands before LogReport.observe reads the observation.
+    """
+
+    trigger = (1, "iteration")
+    priority = PRIORITY_WRITER + 50
+
+    def __init__(self, key: str = "time/step"):
+        self.key = key
+        self._last: Optional[float] = None
+
+    def observe(self, trainer) -> None:
+        import time
+
+        now = time.perf_counter()
+        if self._last is not None:
+            trainer.observation[self.key] = now - self._last
+        self._last = now
+
+    def __call__(self, trainer) -> None:
+        pass
+
+    def state_dict(self) -> dict:
+        return {}  # wall-clock gaps across a resume are meaningless; restart
+
+    def load_state_dict(self, state: dict) -> None:
+        self._last = None
+
+
+class JaxProfiler:
+    """Capture a ``jax.profiler`` trace of iterations [start, stop).
+
+    SURVEY.md §5 rebuild target ("jax.profiler hooks — cheap win"): the
+    trace lands in ``logdir`` in TensorBoard/Perfetto format with the XLA
+    executable timelines — the TPU-native answer to nvprof-wrapping the
+    reference.  Defaults skip iteration 0-1 so compile time doesn't drown
+    the steady-state steps.  Multi-host: every process writes its own
+    host-suffixed trace directory, rank gating is unnecessary.
+    """
+
+    trigger = (1, "iteration")
+    priority = PRIORITY_WRITER + 60  # bracket the step before observers run
+
+    def __init__(self, logdir: str = "profile", start: int = 2,
+                 stop: int = 5):
+        if stop <= start:
+            raise ValueError(f"need stop > start, got [{start}, {stop})")
+        self.logdir = logdir
+        self.start_iteration = int(start)
+        self.stop_iteration = int(stop)
+        self._active = False
+        self._done = False
+
+    def observe(self, trainer) -> None:
+        it = trainer.iteration
+        if (not self._done and not self._active
+                and it + 1 >= self.start_iteration
+                and it < self.stop_iteration):
+            jax.profiler.start_trace(self.logdir)
+            self._active = True
+        elif self._active and it + 1 >= self.stop_iteration:
+            self._stop()
+
+    def _stop(self) -> None:
+        # block so the trace captures the async dispatch queue, not a
+        # still-running step
+        jax.effects_barrier()
+        jax.profiler.stop_trace()
+        self._active = False
+        self._done = True
+
+    def __call__(self, trainer) -> None:
+        pass
+
+    def finalize(self) -> None:
+        if self._active:
+            self._stop()
+
+    def state_dict(self) -> dict:
+        return {"done": self._done}
+
+    def load_state_dict(self, state: dict) -> None:
+        self._done = bool(state.get("done", False))
+        self._active = False
+
+
 class EvaluatorExtension:
     """Run a multi-node evaluator on a trigger, merging results into the
     observation under ``validation/`` keys (Chainer ``Evaluator`` slot [uv])."""

@@ -30,6 +30,8 @@ def main():
     parser.add_argument("--devices", type=int, default=0,
                         help="fake an N-device CPU mesh (0 = real chips)")
     parser.add_argument("--batchsize", type=int, default=64, help="per-chip batch")
+    parser.add_argument("--dataset-size", type=int, default=512,
+                        help="synthetic records held in the prefetch buffer")
     parser.add_argument("--steps", type=int, default=30)
     parser.add_argument("--image-size", type=int, default=224)
     parser.add_argument("--num-classes", type=int, default=1000)
@@ -95,17 +97,30 @@ def main():
     variables = mn.replicate(dict(variables), mesh)
     opt_state = mn.replicate(optimizer.init(variables["params"]), mesh)
 
+    # Input pipeline: the native C++ prefetcher assembles batches in worker
+    # threads (GIL-free) while the previous step computes — the reference's
+    # MultiprocessIterator role (SURVEY.md §2.9).  Synthetic records stand in
+    # for decoded ImageNet when /imagenet is absent; the data PATH (record
+    # buffer → prefetch ring → device_put per step) is the real one.
     data_rng = np.random.RandomState(0)
-    imgs = data_rng.randn(global_batch, args.image_size, args.image_size, 3
-                          ).astype(np.float32)
-    labels = data_rng.randint(0, args.num_classes, global_batch).astype(np.int32)
-    batch = mn.shard_batch((imgs, labels), mesh)
+    n_records = max(args.dataset_size, global_batch)
+    records = data_rng.randn(n_records, args.image_size, args.image_size, 3
+                             ).astype(np.float32)
+    labels = data_rng.randint(0, args.num_classes, n_records).astype(np.int32)
+    # copy=True: device_put is async on real chips, and without the copy the
+    # prefetch ring could recycle the slot under a still-running H2D DMA.
+    it = mn.PrefetchIterator((records, labels), batch_size=global_batch,
+                             shuffle=True, seed=1, copy=True)
+    if comm.rank == 0 and not mn.runtime.native_available():
+        print("note: native prefetcher unavailable, python fallback in use")
 
     # warmup/compile
+    batch = mn.shard_batch(it.next(), mesh)
     variables, opt_state, loss, metrics = step(variables, opt_state, batch)
     loss.block_until_ready()
     t0 = time.time()
     for i in range(args.steps):
+        batch = mn.shard_batch(it.next(), mesh)
         variables, opt_state, loss, metrics = step(variables, opt_state, batch)
         if args.devices:  # lockstep on thin hosts; async on real chips
             loss.block_until_ready()

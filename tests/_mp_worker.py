@@ -1,0 +1,114 @@
+"""Multi-controller test worker — run by tests/test_multiprocess.py.
+
+One subprocess = one jax.distributed process with one CPU device, the
+TPU-native analog of the reference's ``mpiexec -n N pytest`` execution model
+(SURVEY.md §4: every collective was exercised under real multi-process MPI).
+Exercises every ``_multiprocess()`` branch of
+``chainermn_tpu/communicators/xla.py`` (bcast_obj / gather_obj /
+allgather_obj / allreduce_obj / send_obj / recv_obj over the KV store), the
+multi-node + synchronized iterators, the global-except-hook wiring, and
+checkpointer save / maybe_load gang consistency.
+
+Usage: python tests/_mp_worker.py <num_processes> <process_id> <port> <tmpdir>
+Prints "WORKER_OK <id>" on success; any assertion kills the worker nonzero.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    n, i, port, tmpdir = (int(sys.argv[1]), int(sys.argv[2]),
+                          sys.argv[3], sys.argv[4])
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(
+        coordinator_address=f"localhost:{port}", num_processes=n,
+        process_id=i)
+    assert jax.process_count() == n, (jax.process_count(), n)
+
+    import numpy as np
+
+    import chainermn_tpu as mn
+
+    comm = mn.create_communicator("xla")
+    assert comm.size == n, (comm.size, n)  # one CPU device per process
+    rank = comm.rank
+    assert rank == i, (rank, i)
+    assert comm.inter_size == n and comm.intra_size == 1
+
+    # ---- object lane: every _multiprocess() branch in xla.py ----
+    obj = comm.bcast_obj({"v": 42, "arr": [1, 2, 3]} if rank == 0 else None,
+                         root=0)
+    assert obj == {"v": 42, "arr": [1, 2, 3]}, obj
+    # non-zero root, larger-than-root payload on another rank
+    obj = comm.bcast_obj("x" * (1000 * (rank + 1)) if rank == 1 else None,
+                         root=1)
+    assert obj == "x" * 2000, len(obj)
+
+    g = comm.gather_obj(("r", rank, "pad" * rank))
+    assert g == [("r", r, "pad" * r) for r in range(n)], g
+    g = comm.allgather_obj(rank * 10)
+    assert g == [r * 10 for r in range(n)], g
+    total = comm.allreduce_obj(rank + 1)
+    assert total == n * (n + 1) // 2, total
+
+    # p2p over the KV store, incl. sequence numbering (two in flight)
+    nxt, prv = (rank + 1) % n, (rank - 1) % n
+    comm.send_obj({"hop": 1, "from": rank}, dest=nxt)
+    comm.send_obj({"hop": 2, "from": rank}, dest=nxt)
+    m1 = comm.recv_obj(source=prv)
+    m2 = comm.recv_obj(source=prv)
+    assert m1 == {"hop": 1, "from": prv}, m1
+    assert m2 == {"hop": 2, "from": prv}, m2
+
+    # ---- multi-node iterator: all ranks see the MASTER stream ----
+    from chainermn_tpu.iterators import (
+        SerialIterator, create_multi_node_iterator,
+        create_synchronized_iterator)
+
+    base = SerialIterator(list(range(17)), 4, shuffle=True, seed=100 + rank)
+    it = create_multi_node_iterator(base, comm, rank_master=0)
+    batches = [it.next() for _ in range(6)]
+    epochs = (it.epoch, it.is_new_epoch, it.epoch_detail)
+    gathered = comm.allgather_obj((batches, epochs))
+    for b, e in gathered:
+        assert b == gathered[0][0], "divergent multi-node batch streams"
+        assert e == gathered[0][1], "divergent epoch bookkeeping"
+    # state_dict is master-authoritative and identical everywhere
+    sd = it.state_dict()
+    sds = comm.allgather_obj(sorted(sd.keys()))
+    assert all(s == sds[0] for s in sds)
+
+    # ---- synchronized iterator: RNG/order installed from rank 0 ----
+    sync = create_synchronized_iterator(
+        SerialIterator(list(range(12)), 3, shuffle=True, seed=rank), comm)
+    orders = comm.allgather_obj(sync._order.tolist())
+    assert all(o == orders[0] for o in orders), "unsynchronized orders"
+
+    # ---- checkpointer: per-process shards, gang-consistent resume ----
+    from chainermn_tpu.extensions import create_multi_node_checkpointer
+
+    cp = create_multi_node_checkpointer(
+        name="mp", comm=comm, path=tmpdir, keep=2)
+    state = {"rank": rank, "w": np.full((3,), rank, np.float32)}
+    cp.save(state, iteration=10)
+    if rank != 1:  # rank 1 skips gen 20 → 20 must NOT be consistent
+        cp.save(state, iteration=20)
+    comm.bcast_obj(None)  # barrier: all saves visible before maybe_load
+    loaded, it_resumed = cp.maybe_load({"rank": -1, "w": None})
+    assert it_resumed == 10, f"expected newest CONSISTENT gen 10, got {it_resumed}"
+    assert loaded["rank"] == rank  # each process resumes its OWN shard
+    np.testing.assert_array_equal(loaded["w"], state["w"])
+    gens = comm.allgather_obj(cp.get_generations())
+    assert all(g == [10] for g in gens), gens
+    cp.finalize()
+
+    print(f"WORKER_OK {i}")
+
+
+if __name__ == "__main__":
+    main()

@@ -142,6 +142,34 @@ class TestTrainerLoop:
         assert "main/loss" in trainer.observation
 
 
+class TestProfiling:
+    def test_step_timer_feeds_log(self, mlp_setup, tmp_path):
+        """SURVEY §5: per-step wall time lands in the training log."""
+        step_fn, state, comm = mlp_setup
+        trainer = make_trainer(step_fn, state, n_epochs=2, out=str(tmp_path))
+        log = extensions.LogReport(trigger=(1, "epoch"))
+        trainer.extend(extensions.StepTimer())
+        trainer.extend(log)
+        trainer.run()
+        assert "time/step" in log.log[-1]
+        assert log.log[-1]["time/step"] > 0
+
+    def test_jax_profiler_writes_trace(self, mlp_setup, tmp_path):
+        """SURVEY §5: a jax.profiler trace of the chosen iteration window
+        appears in the logdir (TensorBoard/Perfetto format)."""
+        step_fn, state, comm = mlp_setup
+        trainer = make_trainer(step_fn, state, n_epochs=1, out=str(tmp_path))
+        logdir = str(tmp_path / "profile")
+        trainer.extend(extensions.JaxProfiler(logdir=logdir, start=1, stop=3))
+        trainer.run()
+        traces = [f for _, _, fs in os.walk(logdir) for f in fs]
+        assert any("trace" in f for f in traces), traces
+
+    def test_jax_profiler_rejects_empty_window(self):
+        with pytest.raises(ValueError):
+            extensions.JaxProfiler(start=3, stop=3)
+
+
 class TestTrainerResume:
     def test_snapshot_and_resume_identical_stream(self, mlp_setup, tmp_path):
         step_fn, state, comm = mlp_setup
