@@ -219,7 +219,8 @@ def main():
     # --- MFU + sanity bound ------------------------------------------------
     peak = peak_flops_for(dev.device_kind) if on_tpu else None
     mfu = None
-    suspect = False
+    flops_suspect = False  # XLA's FLOP count itself looks elided
+    mfu_suspect = False    # timing implies >peak throughput
     flops_per_image = None
     if flops_per_step:
         flops_per_image = flops_per_step / (global_batch / n_chips)
@@ -228,14 +229,14 @@ def main():
         # a quarter of that, the compiled program is not doing the work.
         analytic = 3 * 4.1e9 * (image_size / 224.0) ** 2
         if flops_per_image < analytic / 4:
-            suspect = True
+            flops_suspect = True
             print(f"bench: WARNING compiled FLOPs/image {flops_per_image:.3g} "
                   f"<< analytic {analytic:.3g} — work is being elided",
                   file=sys.stderr)
     if peak and flops_per_step:
         mfu = flops_per_step * steps / dt / peak
         if mfu > 1.0:
-            suspect = True
+            mfu_suspect = True
             print(f"bench: WARNING MFU {mfu:.2f} > 1.0 is PHYSICALLY "
                   f"IMPOSSIBLE on {dev.device_kind} (peak {peak:.3g} FLOP/s) "
                   f"— the platform is eliding or misreporting work; the "
@@ -269,19 +270,23 @@ def main():
                 batch_sweep[str(b)] = None
 
     # --- headline selection: never report a physically impossible number ---
+    # The fallback can only clear the TIMING suspicion, and only when the
+    # FLOP count itself is trustworthy — sweep-batch MFUs derive from the
+    # same flops_per_image, so an elided count would certify nonsense.
     headline_batch = per_chip_batch
     headline_ips = ips_per_chip
-    if mfu is not None and mfu > 1.0:
+    if mfu_suspect and not flops_suspect:
         credible = {b: e for b, e in batch_sweep.items()
                     if e and e["mfu"] is not None and e["mfu"] <= 1.0}
         if credible:
             headline_batch = max(credible, key=lambda b: credible[b]["ips"])
             headline_ips = credible[headline_batch]["ips"]
-            suspect = False
+            mfu_suspect = False
             print(f"bench: main config (batch {per_chip_batch}) was "
                   f"impossible; headline falls back to credible batch "
                   f"{headline_batch} @ {headline_ips} img/s/chip",
                   file=sys.stderr)
+    suspect = flops_suspect or mfu_suspect
 
     # --- DP weak-scaling sweep (virtual CPU mesh, fresh subprocesses) ------
     scaling = None if args.skip_scaling else run_scaling_sweep()

@@ -150,8 +150,37 @@ class XlaCommunicator(CommunicatorBase):
         return self._program(("bcast", root), fn)(x)
 
     def gather(self, x, root: int = 0):
-        # The rank-major stack IS the gathered array (meaningful at root).
-        return self._check(jnp.asarray(x))
+        """Materialize the full rank-major stack ON root's process.
+
+        Reference contract (``mpi_communicator_base.py :: gather`` [uv]):
+        the payload is meaningful only at root; other ranks receive None.
+        Single-controller (one process owns every rank): the stack already
+        IS the gathered array — returned directly.  Multi-controller: each
+        process contributes its local rows over DCN
+        (``process_allgather``); the root-owning process returns the
+        assembled host array and every other process returns None — the
+        payload physically lands on root's host, which the old rank-major
+        identity never delivered.
+        """
+        x = self._check(jnp.asarray(x))
+        if not self._multiprocess():
+            return x
+        from jax.experimental import multihost_utils
+        # Reassemble by each shard's GLOBAL row index — a blind reshape
+        # would assume rank order == process-major device order, silently
+        # permuting rows for meshes whose devices interleave processes.
+        shards = sorted(x.addressable_shards,
+                        key=lambda s: s.index[0].start or 0)
+        local = np.concatenate([np.asarray(s.data) for s in shards], axis=0)
+        starts = np.asarray([s.index[0].start or 0 for s in shards], np.int64)
+        datas = np.asarray(multihost_utils.process_allgather(local))
+        rows = np.asarray(multihost_utils.process_allgather(starts))
+        full = np.zeros((self.size,) + tuple(x.shape[1:]), local.dtype)
+        per_proc = datas.reshape(rows.shape[0], rows.shape[1], -1)
+        for p in range(rows.shape[0]):
+            for j in range(rows.shape[1]):
+                full[int(rows[p, j])] = per_proc[p, j].reshape(x.shape[1:])
+        return full if self.owns_rank(root) else None
 
     def allgather(self, x):
         x = self._check(jnp.asarray(x))
@@ -173,7 +202,18 @@ class XlaCommunicator(CommunicatorBase):
         return self._program(("alltoall",), fn)(x)
 
     def scatter(self, x, root: int = 0):
-        # Root's (size, *s) payload in rank-major layout is already scattered.
+        """Distribute root's ``(size, *s)`` payload so each rank holds its
+        row (reference: ``scatter`` [uv] — only root's buffer matters).
+
+        Single-controller: placing the rank-major stack IS the scatter.
+        Multi-controller: non-root processes may pass ``x=None``; root's
+        payload crosses DCN once (bcast) and lands in the stack sharding,
+        each process keeping only its addressable rows.
+        """
+        if self._multiprocess():
+            payload = self.bcast_obj(
+                np.asarray(x) if self.owns_rank(root) else None, root=root)
+            return self._place(np.asarray(payload))
         return self._check(jnp.asarray(x))
 
     def send(self, x, dest: int, source: int):

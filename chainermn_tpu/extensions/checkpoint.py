@@ -13,6 +13,13 @@ consistency agreement rides the DCN object lane (``allgather_obj``) instead
 of MPI.  State is any picklable pytree — train state, optimizer state, and
 iterator ``state_dict`` all qualify; device arrays are pulled to host first
 so a checkpoint never pins HBM.
+
+Async writes (orbax-style, SURVEY.md §5 build note): ``save`` detaches the
+state to host (the only device sync) and hands serialize+write to a
+single background thread; the train loop continues immediately.  Depth is
+bounded at one in-flight write (a new save waits out the previous one),
+every read/consistency operation joins the writer first, and writer errors
+re-raise at the next checkpoint call instead of vanishing.
 """
 
 from __future__ import annotations
@@ -49,7 +56,8 @@ class MultiNodeCheckpointer:
     """
 
     def __init__(self, name: str, comm: CommunicatorBase, path: str,
-                 cp_interval: int = 5, gc_interval: int = 5, keep: int = 5):
+                 cp_interval: int = 5, gc_interval: int = 5, keep: int = 5,
+                 async_write: bool = True):
         self.name = name
         self.comm = comm
         self.path = path
@@ -60,6 +68,9 @@ class MultiNodeCheckpointer:
             raise ValueError("keep must be >= 1 (GC may never delete the "
                              "newest generation)")
         self._saves_since_gc = 0
+        self._async = bool(async_write)
+        self._executor = None
+        self._pending = None  # Future of the one in-flight write
         os.makedirs(path, exist_ok=True)
 
     # ---- naming ----
@@ -95,15 +106,44 @@ class MultiNodeCheckpointer:
     def _local_generations(self, any_world_size: bool = False) -> List[int]:
         return [it for it, _ in self._local_files(any_world_size)]
 
+    # ---- async writer plumbing ----
+    def _join_writer(self) -> None:
+        """Wait out the in-flight write; re-raise its error if it failed."""
+        if self._pending is not None:
+            fut, self._pending = self._pending, None
+            fut.result()
+
+    def _submit(self, fn, *args):
+        from concurrent.futures import ThreadPoolExecutor
+
+        if self._executor is None:
+            self._executor = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix=f"ckpt-{self.name}")
+        self._pending = self._executor.submit(fn, *args)
+
+    def flush(self) -> None:
+        """Block until the in-flight async write (if any) is on disk."""
+        self._join_writer()
+
     # ---- save / load ----
     def save(self, state: Any, iteration: int) -> None:
         """Snapshot this process's shard of ``state`` at ``iteration``.
 
         Atomic per shard (tmp file + rename) so a crash mid-save never
         corrupts an older generation — the reference relied on the same
-        write-then-rename discipline [uv].
+        write-then-rename discipline [uv].  With ``async_write`` (default)
+        only the device→host detach happens here; pickling and disk IO run
+        on the writer thread while the next steps compute.
         """
-        payload = pickle.dumps(_to_host(state), protocol=pickle.HIGHEST_PROTOCOL)
+        host_state = _to_host(state)
+        if not self._async:
+            self._write(host_state, iteration)
+            return
+        self._join_writer()  # bounded depth: one write in flight
+        self._submit(self._write, host_state, iteration)
+
+    def _write(self, host_state: Any, iteration: int) -> None:
+        payload = pickle.dumps(host_state, protocol=pickle.HIGHEST_PROTOCOL)
         target = self._filename(iteration)
         fd, tmp = tempfile.mkstemp(dir=self.path, prefix=".tmp_ckpt_")
         try:
@@ -150,6 +190,7 @@ class MultiNodeCheckpointer:
         crashed and fresh-started halves (the reference required same rank
         count [uv]; here it is enforced, loudly and collectively).
         """
+        self._join_writer()  # our newest shard must be on disk and visible
         gens = self._consistent_generations()
         if not gens:
             any_stale = any(self.comm.allgather_obj(
@@ -170,11 +211,16 @@ class MultiNodeCheckpointer:
 
     def get_generations(self) -> List[int]:
         """Consistent generations currently resumable (newest last)."""
+        self._join_writer()
         return self._consistent_generations()
 
     def finalize(self) -> None:
         """Delete every local shard (reference: cleanup on job teardown [uv]),
         including shards saved under a different world size."""
+        self._join_writer()
+        if self._executor is not None:
+            self._executor.shutdown(wait=True)
+            self._executor = None
         for _, path in self._local_files(any_world_size=True):
             try:
                 os.unlink(path)
@@ -198,10 +244,12 @@ def create_multi_node_checkpointer(
     gc_interval: int = 5,
     path: Optional[str] = None,
     keep: int = 5,
+    async_write: bool = True,
 ) -> MultiNodeCheckpointer:
     """Factory with the reference's signature (``create_multi_node_checkpointer``
     [uv]); ``path`` defaults to ``./{name}-checkpoints`` like the reference's
     cwd-relative default."""
     if path is None:
         path = os.path.join(os.getcwd(), f"{name}-checkpoints")
-    return MultiNodeCheckpointer(name, comm, path, cp_interval, gc_interval, keep)
+    return MultiNodeCheckpointer(name, comm, path, cp_interval, gc_interval,
+                                 keep, async_write)

@@ -92,15 +92,17 @@ class MultiNodeChainList:
             return value
         return jax.device_put(value, device)
 
-    def params(self, placed: bool = True) -> List[Any]:
+    def params(self, placed: bool = False) -> List[Any]:
         """Per-stage parameter pytrees (differentiable argument list for
         ``__call__(x, params=...)``).
 
-        ``placed=True`` (default): each stage's pytree stays committed to
-        its rank's chip — feed the eager placed face.  ``placed=False``:
-        uncommitted host copies — required when the whole list is an
+        ``placed=False`` (default): uncommitted host copies — safe as an
         argument of ONE fused ``jax.jit`` (jit rejects arguments committed
-        to different chips; the fused program's placement belongs to XLA).
+        to different chips), and the pre-placement behavior callers relied
+        on.  ``placed=True``: each stage's pytree committed to its rank's
+        chip, for driving the eager placed face explicitly.  Either way the
+        *internally stored* stage params stay pinned, so ``mnc(x)`` without
+        a params override always executes placed.
         """
         if placed:
             return [s.params for s in self._stages]

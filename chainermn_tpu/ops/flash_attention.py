@@ -223,8 +223,10 @@ def _bwd_blockwise(q, k, v, out, lse, do, causal, scale, block_k, seq_len,
                           preferred_element_type=jnp.float32)
         return dq_acc, (dk_b, dv_b)
 
+    # Derive the accumulator from q (not jnp.zeros) so it inherits q's
+    # varying-axes type — inside shard_map, scan demands carry-in/out agree.
     dq, (dks, dvs) = jax.lax.scan(
-        step, jnp.zeros(q.shape, jnp.float32), jnp.arange(nk))
+        step, q.astype(jnp.float32) * 0, jnp.arange(nk))
     # (nk, BH, bk, D) → (BH, nk·bk=S, D); blocks were emitted in order.
     dk = dks.transpose(1, 0, 2, 3).reshape(bh, s, d)
     dv = dvs.transpose(1, 0, 2, 3).reshape(bh, s, d)

@@ -34,6 +34,14 @@ from .hybrid import (  # noqa: F401
     shard_pytree,
     state_specs_like,
 )
+from .transformer import (  # noqa: F401
+    init_tp_transformer_lm,
+    tp_attention,
+    tp_block,
+    tp_transformer_lm_loss,
+    transformer_lm_specs,
+    vocab_parallel_logits_loss,
+)
 from .tensor_parallel import (  # noqa: F401
     column_parallel_dense,
     init_tp_mlp_params,
@@ -67,4 +75,10 @@ __all__ = [
     "make_hybrid_shard_map_step",
     "shard_pytree",
     "state_specs_like",
+    "init_tp_transformer_lm",
+    "tp_attention",
+    "tp_block",
+    "tp_transformer_lm_loss",
+    "transformer_lm_specs",
+    "vocab_parallel_logits_loss",
 ]

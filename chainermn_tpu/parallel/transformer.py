@@ -1,0 +1,224 @@
+"""Tensor-parallel Transformer LM: Megatron-style sharding over one axis.
+
+Reference relationship: the reference shipped the raw differentiable
+collectives that make intra-layer model parallelism *expressible*
+(SURVEY.md §2.8 "TP: expressible manually via functions.allgather/alltoall;
+no library support") but no transformer and no TP library.  This module is
+that missing layer, built TPU-first:
+
+* **Attention**: QKV projections are column-parallel (heads sharded over
+  the model axis — each chip owns ``H/P`` heads and attends them with the
+  in-tree flash kernel or plain XLA attention), the output projection is
+  row-parallel.  ONE psum of cross-chip traffic per attention block.
+* **MLP**: column→gelu→row (:func:`tensor_parallel.tp_mlp`), one psum.
+* **Embedding / LM head**: vocab-parallel (each chip owns a vocab shard);
+  the logits stay vocab-sharded and the cross-entropy computes from the
+  sharded logits with two scalar-sized psums (max and log-sum-exp legs) —
+  the full ``(B, S, V)`` logits never materialize on one chip.
+* **LayerNorms, residuals**: replicated compute (cheap, bandwidth-bound).
+
+Compose with data parallelism over a ``('data', 'model')`` mesh via
+``parallel.hybrid.make_hybrid_shard_map_step`` — the loss below is per-token
+mean over the LOCAL batch shard, exactly what that builder pmeans.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from .tensor_parallel import column_parallel_dense, row_parallel_dense, tp_mlp
+
+
+def _layer_norm(x, scale, bias, eps=1e-5):
+    x32 = x.astype(jnp.float32)
+    mu = x32.mean(-1, keepdims=True)
+    var = ((x32 - mu) ** 2).mean(-1, keepdims=True)
+    y = (x32 - mu) * jax.lax.rsqrt(var + eps)
+    return (y * scale + bias).astype(x.dtype)
+
+
+def tp_attention(x, params, *, head_dim: int, axis_name: str,
+                 causal: bool = True, attn_impl: str = "xla"):
+    """Multi-head self-attention with heads sharded over ``axis_name``.
+
+    ``x``: replicated-local ``(B, S, D)``; ``params``: local shards
+    ``wqkv (D, 3·D/P)`` laid out HEAD-MAJOR (columns grouped per head as
+    ``[q_h | k_h | v_h]`` so a contiguous column shard is whole heads —
+    see :func:`init_tp_transformer_lm`), ``bqkv (3·D/P,)``,
+    ``wo (D/P, D)``, replicated ``bo (D,)``.  One psum (in the
+    row-parallel output projection) per call.
+    """
+    b, s, d = x.shape
+    h_local = params["bqkv"].shape[0] // (3 * head_dim)
+
+    qkv = column_parallel_dense(x, params["wqkv"], params["bqkv"],
+                                axis_name=axis_name)        # (B, S, 3·Dl)
+    qkv = qkv.reshape(b, s, h_local, 3, head_dim)
+    q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]  # (B, S, hl, hd)
+
+    if attn_impl == "flash":
+        from ..ops.flash_attention import flash_attention
+        ctx = flash_attention(q, k, v, causal=causal)
+    else:
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                            preferred_element_type=jnp.float32)
+        scores = scores / (head_dim ** 0.5)
+        if causal:
+            mask = jnp.arange(s)[:, None] >= jnp.arange(s)[None, :]
+            scores = jnp.where(mask[None, None], scores, -1e30)
+        p = jax.nn.softmax(scores, axis=-1)
+        ctx = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+                         preferred_element_type=jnp.float32).astype(x.dtype)
+
+    ctx = ctx.reshape(b, s, h_local * head_dim)             # (B, S, D/P)
+    return row_parallel_dense(ctx, params["wo"], params["bo"],
+                              axis_name=axis_name)
+
+
+def tp_block(x, params, *, head_dim: int, axis_name: str, causal: bool = True,
+             attn_impl: str = "xla"):
+    """Pre-norm transformer block: LN→attn→residual, LN→MLP→residual."""
+    h = _layer_norm(x, params["ln1_scale"], params["ln1_bias"])
+    x = x + tp_attention(h, params["attn"], head_dim=head_dim,
+                         axis_name=axis_name, causal=causal,
+                         attn_impl=attn_impl)
+    h = _layer_norm(x, params["ln2_scale"], params["ln2_bias"])
+    return x + tp_mlp(h, params["mlp"], axis_name=axis_name)
+
+
+def vocab_parallel_logits_loss(h, table, targets, *, axis_name: str):
+    """Cross-entropy from VOCAB-SHARDED logits — ``(B, S, V)`` never
+    materializes unsharded.
+
+    ``h (B, S, D)`` replicated-local; ``table (V/P, D)`` the local vocab
+    shard of the (tied) embedding; ``targets (B, S)`` global token ids.
+    Three cheap collectives: pmax (stable shift), psum of the local
+    exp-sum, psum of the target-logit one-hot pick.
+    """
+    vocab_per = table.shape[0]
+    start = jax.lax.axis_index(axis_name) * vocab_per
+    logits = jnp.einsum("bsd,vd->bsv", h, table,
+                        preferred_element_type=jnp.float32)  # (B, S, V/P)
+
+    # The max shift is numerics-only: its gradient contribution cancels
+    # analytically (d/dx of m + log Σ exp(x−m) ignores m), and pmax has no
+    # differentiation rule — so cut it out of the tangent graph entirely.
+    m = jax.lax.pmax(jax.lax.stop_gradient(logits).max(-1), axis_name)  # (B, S)
+    sumexp = jax.lax.psum(
+        jnp.exp(logits - m[..., None]).sum(-1), axis_name)   # (B, S)
+    local_t = targets - start
+    in_range = (local_t >= 0) & (local_t < vocab_per)
+    picked = jnp.take_along_axis(
+        logits, jnp.clip(local_t, 0, vocab_per - 1)[..., None], axis=-1)[..., 0]
+    target_logit = jax.lax.psum(jnp.where(in_range, picked, 0.0), axis_name)
+    return jnp.mean(m + jnp.log(sumexp) - target_logit)
+
+
+def tp_transformer_lm_loss(params, batch, *, head_dim: int, axis_name: str,
+                           causal: bool = True, attn_impl: str = "xla"):
+    """Per-token mean NLL of a decoder-only LM over the LOCAL batch shard.
+
+    ``batch``: ``(tokens (B, S+1) int32,)`` — inputs are ``[:, :-1]``,
+    targets ``[:, 1:]``.  Feed to ``make_hybrid_shard_map_step`` for DP×TP
+    (``functools.partial`` the static args first).
+    """
+    tokens = batch[0]
+    inputs, targets = tokens[:, :-1], tokens[:, 1:]
+    from .tensor_parallel import vocab_parallel_embedding
+
+    x = vocab_parallel_embedding(inputs, params["embed"], axis_name=axis_name)
+    x = x * (params["embed"].shape[1] ** 0.5)
+    x = x + params["pos_embed"][: x.shape[1]][None]
+    for blk in params["blocks"]:
+        x = tp_block(x, blk, head_dim=head_dim, axis_name=axis_name,
+                     causal=causal, attn_impl=attn_impl)
+    x = _layer_norm(x, params["lnf_scale"], params["lnf_bias"])
+    return vocab_parallel_logits_loss(x, params["embed"], targets,
+                                      axis_name=axis_name)
+
+
+# ---- init + specs (GLOBAL params; shard with transformer_lm_specs) ----
+
+def init_tp_transformer_lm(rng, vocab: int, d_model: int, n_heads: int,
+                           n_layers: int, d_hidden: Optional[int] = None,
+                           max_len: int = 512, dtype=jnp.float32) -> Dict[str, Any]:
+    """GLOBAL (unsharded) parameter pytree for the TP transformer LM."""
+    if d_model % n_heads:
+        raise ValueError(f"d_model {d_model} not divisible by n_heads {n_heads}")
+    d_hidden = d_hidden or 4 * d_model
+    head_dim = d_model // n_heads
+    keys = jax.random.split(rng, 2 + 4 * n_layers)
+    scale = lambda fan_in: (2.0 / fan_in) ** 0.5
+
+    def dense(key, n_in, n_out):
+        return (jax.random.normal(key, (n_in, n_out)) * scale(n_in)).astype(dtype)
+
+    blocks = []
+    for i in range(n_layers):
+        k1, k2, k3, k4 = keys[2 + 4 * i: 6 + 4 * i]
+        # Head-major qkv layout: columns are [head0: q|k|v, head1: q|k|v, …]
+        # so a contiguous column shard over the model axis is whole heads.
+        wq, wk, wv = (dense(kk, d_model, d_model).reshape(
+            d_model, n_heads, head_dim) for kk in jax.random.split(k1, 3))
+        wqkv = jnp.stack([wq, wk, wv], axis=2).reshape(d_model, 3 * d_model)
+        blocks.append({
+            "ln1_scale": jnp.ones((d_model,), dtype),
+            "ln1_bias": jnp.zeros((d_model,), dtype),
+            "ln2_scale": jnp.ones((d_model,), dtype),
+            "ln2_bias": jnp.zeros((d_model,), dtype),
+            "attn": {
+                "wqkv": wqkv,
+                "bqkv": jnp.zeros((3 * d_model,), dtype),
+                "wo": dense(k2, d_model, d_model),
+                "bo": jnp.zeros((d_model,), dtype),
+            },
+            "mlp": {
+                "wi": dense(k3, d_model, d_hidden),
+                "bi": jnp.zeros((d_hidden,), dtype),
+                "wo": dense(k4, d_hidden, d_model),
+                "bo": jnp.zeros((d_model,), dtype),
+            },
+        })
+    return {
+        "embed": (jax.random.normal(keys[0], (vocab, d_model))
+                  * scale(d_model)).astype(dtype),
+        "pos_embed": (jax.random.normal(keys[1], (max_len, d_model))
+                      * 0.02).astype(dtype),
+        "blocks": blocks,
+        "lnf_scale": jnp.ones((d_model,), dtype),
+        "lnf_bias": jnp.zeros((d_model,), dtype),
+    }
+
+
+def transformer_lm_specs(params, axis_name: str = "model"):
+    """PartitionSpecs matching :func:`init_tp_transformer_lm`'s pytree.
+
+    QKV / MLP-in are column-sharded, attention-out / MLP-out row-sharded,
+    the tied embedding vocab-sharded, norms/positions replicated.  ``wqkv``
+    column-sharding is head-granular automatically because heads are the
+    fastest-varying dim of its 3·D output.
+    """
+    ax = axis_name
+
+    def block_specs(blk):
+        return {
+            "ln1_scale": P(), "ln1_bias": P(),
+            "ln2_scale": P(), "ln2_bias": P(),
+            "attn": {"wqkv": P(None, ax), "bqkv": P(ax),
+                     "wo": P(ax, None), "bo": P()},
+            "mlp": {"wi": P(None, ax), "bi": P(ax),
+                    "wo": P(ax, None), "bo": P()},
+        }
+
+    return {
+        "embed": P(ax, None),
+        "pos_embed": P(),
+        "blocks": [block_specs(b) for b in params["blocks"]],
+        "lnf_scale": P(),
+        "lnf_bias": P(),
+    }

@@ -82,9 +82,9 @@ def main():
         return jnp.mean(optax.sigmoid_binary_cross_entropy(logits, ys))
 
     opt = optax.adam(1e-2)
-    # Fused-jit face: one jit argument → uncommitted params (the eager
-    # placed face would pin each stage's pytree to its chip instead).
-    plist = mnc.params(placed=False)
+    # Fused-jit face: one jit argument → the default uncommitted params()
+    # (params(placed=True) would pin each stage's pytree to its chip).
+    plist = mnc.params()
     state = opt.init(plist)
 
     @jax.jit

@@ -65,6 +65,17 @@ def main():
     assert m1 == {"hop": 1, "from": prv}, m1
     assert m2 == {"hop": 2, "from": prv}, m2
 
+    # ---- array gather/scatter: real root semantics over DCN ----
+    data = np.stack([np.full((2,), 10.0 * r, np.float32) for r in range(n)])
+    xs = comm.scatter(data if rank == 0 else None, root=0)
+    mine = np.asarray([s.data for s in xs.addressable_shards][0])
+    np.testing.assert_array_equal(mine.reshape(2), data[rank])
+    g = comm.gather(xs, root=0)
+    if comm.owns_rank(0):
+        np.testing.assert_array_equal(np.asarray(g), data)
+    else:
+        assert g is None, "gather payload must be root-only"
+
     # ---- multi-node iterator: all ranks see the MASTER stream ----
     from chainermn_tpu.iterators import (
         SerialIterator, create_multi_node_iterator,

@@ -84,9 +84,9 @@ def test_chain_list_differentiable_end_to_end():
         return jnp.mean((mnc(x, params=plist) - y) ** 2)
 
     opt = optax.adam(1e-2)
-    # fused-jit face: the params list is ONE jit argument, so it must be
-    # uncommitted (jit rejects args pinned to different chips)
-    plist = mnc.params(placed=False)
+    # fused-jit face: the params list is ONE jit argument, so the default
+    # (uncommitted) params() is required — placed=True would pin to chips
+    plist = mnc.params()
     state = opt.init(plist)
     l0 = None
     step = jax.jit(lambda pl, st: _step(pl, st))
