@@ -166,20 +166,10 @@ class XlaCommunicator(CommunicatorBase):
         if not self._multiprocess():
             return x
         from jax.experimental import multihost_utils
-        # Reassemble by each shard's GLOBAL row index — a blind reshape
-        # would assume rank order == process-major device order, silently
-        # permuting rows for meshes whose devices interleave processes.
-        shards = sorted(x.addressable_shards,
-                        key=lambda s: s.index[0].start or 0)
-        local = np.concatenate([np.asarray(s.data) for s in shards], axis=0)
-        starts = np.asarray([s.index[0].start or 0 for s in shards], np.int64)
-        datas = np.asarray(multihost_utils.process_allgather(local))
-        rows = np.asarray(multihost_utils.process_allgather(starts))
-        full = np.zeros((self.size,) + tuple(x.shape[1:]), local.dtype)
-        per_proc = datas.reshape(rows.shape[0], rows.shape[1], -1)
-        for p in range(rows.shape[0]):
-            for j in range(rows.shape[1]):
-                full[int(rows[p, j])] = per_proc[p, j].reshape(x.shape[1:])
+        # process_allgather on the GLOBAL array reassembles by each shard's
+        # global index (verified under a real 2-process gang), so arbitrary
+        # rank→process interleavings come back in rank order.
+        full = np.asarray(multihost_utils.process_allgather(x, tiled=True))
         return full if self.owns_rank(root) else None
 
     def allgather(self, x):
@@ -206,15 +196,33 @@ class XlaCommunicator(CommunicatorBase):
         row (reference: ``scatter`` [uv] — only root's buffer matters).
 
         Single-controller: placing the rank-major stack IS the scatter.
-        Multi-controller: non-root processes may pass ``x=None``; root's
-        payload crosses DCN once (bcast) and lands in the stack sharding,
-        each process keeping only its addressable rows.
+        Multi-controller: non-root processes may pass ``x=None``; root
+        sends each process ONLY its rows over the KV-store lane (a bcast
+        of the whole stack would move P× the necessary bytes over DCN),
+        and every process installs its block into the stack sharding.
         """
-        if self._multiprocess():
-            payload = self.bcast_obj(
-                np.asarray(x) if self.owns_rank(root) else None, root=root)
-            return self._place(np.asarray(payload))
-        return self._check(jnp.asarray(x))
+        if not self._multiprocess():
+            return self._check(jnp.asarray(x))
+        from jax.experimental import multihost_utils
+
+        me = jax.process_index()
+        ranks_of = {}
+        for r, d in enumerate(self._devices):
+            ranks_of.setdefault(d.process_index, []).append(r)
+        if self.owns_rank(root):
+            x = np.asarray(x)
+            self._check_leading(x)
+            for proc, ranks in ranks_of.items():
+                if proc == me:
+                    continue
+                self.send_obj(x[np.asarray(ranks)], dest=ranks[0])
+            local = x[np.asarray(ranks_of[me])]
+        else:
+            local = np.asarray(self.recv_obj(source=root))
+        # Local rows are ordered by this process's ranks in mesh order,
+        # exactly the layout host_local_array_to_global_array expects.
+        return multihost_utils.host_local_array_to_global_array(
+            local, self.mesh, P(self.axis_name))
 
     def send(self, x, dest: int, source: int):
         x = self._check(jnp.asarray(x))

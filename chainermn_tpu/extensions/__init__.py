@@ -6,6 +6,7 @@ from .observation_aggregator import (  # noqa: F401
     ObservationAggregator,
     aggregate_observations,
 )
+from .watchdog import Watchdog  # noqa: F401
 
 __all__ = [
     "AllreducePersistent",
@@ -14,4 +15,5 @@ __all__ = [
     "create_multi_node_checkpointer",
     "ObservationAggregator",
     "aggregate_observations",
+    "Watchdog",
 ]

@@ -132,18 +132,20 @@ class MultiNodeCheckpointer:
         Atomic per shard (tmp file + rename) so a crash mid-save never
         corrupts an older generation — the reference relied on the same
         write-then-rename discipline [uv].  With ``async_write`` (default)
-        only the device→host detach happens here; pickling and disk IO run
-        on the writer thread while the next steps compute.
+        the device→host detach AND the pickle happen here, synchronously —
+        serializing on the writer thread would capture live references to
+        mutable state (iterator orders, log accumulators) that the train
+        loop keeps mutating; only the disk IO is deferred.
         """
-        host_state = _to_host(state)
+        payload = pickle.dumps(_to_host(state),
+                               protocol=pickle.HIGHEST_PROTOCOL)
         if not self._async:
-            self._write(host_state, iteration)
+            self._write(payload, iteration)
             return
         self._join_writer()  # bounded depth: one write in flight
-        self._submit(self._write, host_state, iteration)
+        self._submit(self._write, payload, iteration)
 
-    def _write(self, host_state: Any, iteration: int) -> None:
-        payload = pickle.dumps(host_state, protocol=pickle.HIGHEST_PROTOCOL)
+    def _write(self, payload: bytes, iteration: int) -> None:
         target = self._filename(iteration)
         fd, tmp = tempfile.mkstemp(dir=self.path, prefix=".tmp_ckpt_")
         try:
@@ -216,16 +218,20 @@ class MultiNodeCheckpointer:
 
     def finalize(self) -> None:
         """Delete every local shard (reference: cleanup on job teardown [uv]),
-        including shards saved under a different world size."""
-        self._join_writer()
-        if self._executor is not None:
-            self._executor.shutdown(wait=True)
-            self._executor = None
-        for _, path in self._local_files(any_world_size=True):
-            try:
-                os.unlink(path)
-            except FileNotFoundError:
-                pass
+        including shards saved under a different world size.  Cleanup runs
+        even when the last in-flight write failed — its error re-raises
+        AFTER the contract is honored."""
+        try:
+            self._join_writer()
+        finally:
+            if self._executor is not None:
+                self._executor.shutdown(wait=True)
+                self._executor = None
+            for _, path in self._local_files(any_world_size=True):
+                try:
+                    os.unlink(path)
+                except FileNotFoundError:
+                    pass
 
     # ---- trainer-extension face (chainermn_tpu.training) ----
     # When registering directly (``trainer.extend(checkpointer)``) the save

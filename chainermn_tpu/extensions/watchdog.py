@@ -1,0 +1,122 @@
+"""Hang/deadlock detection for distributed training.
+
+SURVEY.md §5: the reference had deadlock *mitigation* only — the global
+except hook turns a raised exception into ``MPI_Abort``, but a rank stuck
+inside a collective raises nothing and the gang hangs silently forever
+(the classic NCCL failure mode; same story for a wedged DCN transfer).
+
+This extension closes that gap: a daemon thread watches the wall-clock gap
+since the last completed training step and, when it exceeds ``timeout``,
+dumps every Python thread's stack (so the hang site is in the log) and
+aborts the process loudly — by default through the same
+coordinator-shutdown path as :mod:`chainermn_tpu.global_except_hook`, so
+one hung rank kills the whole gang instead of wedging it.
+"""
+
+from __future__ import annotations
+
+import faulthandler
+import os
+import sys
+import threading
+import time
+from typing import Callable, Optional
+
+
+def _default_abort(gap: float, timeout: float) -> None:
+    print(f"[chainermn_tpu watchdog] no step completed for {gap:.0f}s "
+          f"(timeout {timeout:.0f}s) — dumping stacks and aborting the gang",
+          file=sys.stderr, flush=True)
+    faulthandler.dump_traceback(file=sys.stderr)
+    try:
+        import jax
+        jax.distributed.shutdown()
+    except Exception:
+        pass
+    os._exit(43)
+
+
+class Watchdog:
+    """Abort the job if no training step completes within ``timeout``.
+
+    Register like any trainer extension; ``observe`` (called every
+    iteration) feeds the heartbeat, and the watcher ALSO reads the
+    trainer's ``last_progress`` stamp, which the loop updates after the
+    step and after every individual extension — so a slow-but-progressing
+    extension pass (a long eval, a checkpoint flush) never false-triggers;
+    only ONE unit of work stuck for longer than ``timeout`` fires.
+
+    ``action(gap, timeout)`` overrides the abort for testing or custom
+    escalation; the default kills the process (and with it the coordinator
+    session, so the rest of the gang dies loudly rather than waiting in a
+    collective).  The timer only runs between ``initialize`` and
+    ``finalize`` — setup work before training starts cannot false-trigger.
+    """
+
+    trigger = (1, "iteration")
+    priority = 10_000  # heartbeat first, before any slow extension runs
+    finalize_on_error = True  # the trainer disarms us when run() unwinds —
+    # an armed watchdog would os._exit a process saving crash diagnostics
+
+    def __init__(self, timeout: float = 600.0,
+                 action: Optional[Callable[[float, float], None]] = None,
+                 poll_interval: Optional[float] = None):
+        if timeout <= 0:
+            raise ValueError(f"timeout must be positive, got {timeout}")
+        self.timeout = float(timeout)
+        self.action = action or _default_abort
+        self.poll_interval = poll_interval or max(self.timeout / 4, 0.05)
+        self._last = None
+        self._trainer = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- extension surface --
+    def initialize(self, trainer) -> None:
+        self._trainer = trainer
+        self._last = time.monotonic()
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._watch, name="chainermn-tpu-watchdog", daemon=True)
+        self._thread.start()
+
+    def observe(self, trainer) -> None:
+        self._trainer = trainer
+        self._last = time.monotonic()
+
+    def __call__(self, trainer) -> None:
+        pass
+
+    def finalize(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    # -- the watcher --
+    def _heartbeat(self) -> Optional[float]:
+        """Most recent sign of life: our own observe stamp or the trainer's
+        per-unit progress stamp, whichever is newer."""
+        beats = [self._last]
+        progress = getattr(self._trainer, "last_progress", None)
+        if progress is not None:
+            beats.append(progress)
+        beats = [b for b in beats if b is not None]
+        return max(beats) if beats else None
+
+    def _watch(self) -> None:
+        while not self._stop.wait(self.poll_interval):
+            last = self._heartbeat()
+            if last is None:
+                continue
+            gap = time.monotonic() - last
+            if gap > self.timeout:
+                self.action(gap, self.timeout)
+                return
+
+    # resume contract: a watchdog carries no durable state
+    def state_dict(self) -> dict:
+        return {}
+
+    def load_state_dict(self, state: dict) -> None:
+        self._last = time.monotonic() if self._thread is not None else None

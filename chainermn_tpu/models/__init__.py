@@ -1,1 +1,2 @@
+from .convnets import AlexNet, GoogLeNet, VGG16  # noqa: F401
 from .mlp import MLP, accuracy, cross_entropy_loss  # noqa: F401

@@ -1,0 +1,171 @@
+"""Classic ImageNet convnets: AlexNet, VGG-16, GoogLeNet (Inception v1).
+
+Reference parity: ``examples/imagenet/models/{alex,googlenet,...}.py`` [uv]
+(SURVEY.md §2.9 — the reference's ImageNet example shipped a model zoo, not
+just ResNet).  Same TPU-first conventions as ``resnet.py``: NHWC, bf16
+convs/matmuls on the MXU, fp32 params and loss; all three expose the
+``(x, train=...) -> logits`` interface the DP example and train-step
+builders expect, and register in ``resnet.ARCHS`` for the imagenet CLI.
+
+``stem_strides`` mirrors the ResNet knob: the ImageNet stem at small test
+resolutions (32 px CI runs) collapses spatial dims too fast, so strides
+soften when ``stem_strides == 1``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+ModuleDef = Any
+
+
+class AlexNet(nn.Module):
+    """AlexNet (one-tower variant), BN instead of LRN — the modern form."""
+
+    num_classes: int = 1000
+    stem_strides: int = 2  # >=2: ImageNet stem; 1: small-input test mode
+    dtype: Any = jnp.bfloat16
+    # 0.0 (default) = no dropout: the step builders don't thread a dropout
+    # rng; classic-recipe users can set 0.5 and pass rngs= to apply()
+    dropout_rate: float = 0.0
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(nn.Conv, dtype=self.dtype)
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-5, dtype=self.dtype)
+        big = self.stem_strides > 1
+        x = x.astype(self.dtype)
+        x = conv(64, (11, 11) if big else (3, 3),
+                 strides=(4, 4) if big else (1, 1))(x)
+        x = nn.relu(norm()(x))
+        if big:
+            x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = nn.relu(norm()(conv(192, (5, 5))(x)))
+        if big:
+            x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = nn.relu(norm()(conv(384, (3, 3))(x)))
+        x = nn.relu(norm()(conv(256, (3, 3))(x)))
+        x = nn.relu(norm()(conv(256, (3, 3))(x)))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2)) if big else x
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(4096, dtype=self.dtype)(x))
+        x = self._drop(x, train)
+        x = nn.relu(nn.Dense(4096, dtype=self.dtype)(x))
+        x = self._drop(x, train)
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+
+    def _drop(self, x, train):
+        if self.dropout_rate > 0:
+            return nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        return x
+
+
+class VGG16(nn.Module):
+    """VGG-16 with BatchNorm (configuration D)."""
+
+    num_classes: int = 1000
+    stem_strides: int = 2  # 1 skips the final pools for small inputs
+    dtype: Any = jnp.bfloat16
+    dropout_rate: float = 0.0
+    cfg: Sequence = ((64, 64), (128, 128), (256, 256, 256),
+                     (512, 512, 512), (512, 512, 512))
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-5, dtype=self.dtype)
+        x = x.astype(self.dtype)
+        for i, widths in enumerate(self.cfg):
+            for w in widths:
+                x = nn.relu(norm()(conv(w, (3, 3))(x)))
+            # small-input mode: stop pooling once spatial dims are tiny
+            if self.stem_strides > 1 or min(x.shape[1:3]) > 4:
+                x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(4096, dtype=self.dtype)(x))
+        x = self._drop(x, train)
+        x = nn.relu(nn.Dense(4096, dtype=self.dtype)(x))
+        x = self._drop(x, train)
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+
+    def _drop(self, x, train):
+        if self.dropout_rate > 0:
+            return nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        return x
+
+
+class _Inception(nn.Module):
+    """Inception v1 block: 1x1 / 1x1→3x3 / 1x1→5x5 / pool→1x1 branches."""
+
+    b1: int
+    b3r: int
+    b3: int
+    b5r: int
+    b5: int
+    bp: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-5, dtype=self.dtype)
+
+        def unit(y, width, kernel):
+            return nn.relu(norm()(conv(width, kernel)(y)))
+
+        p1 = unit(x, self.b1, (1, 1))
+        p3 = unit(unit(x, self.b3r, (1, 1)), self.b3, (3, 3))
+        p5 = unit(unit(x, self.b5r, (1, 1)), self.b5, (5, 5))
+        pp = nn.max_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+        pp = unit(pp, self.bp, (1, 1))
+        return jnp.concatenate([p1, p3, p5, pp], axis=-1)
+
+
+class GoogLeNet(nn.Module):
+    """GoogLeNet / Inception v1 (BN form, no aux heads — eval-equivalent)."""
+
+    num_classes: int = 1000
+    stem_strides: int = 2
+    dtype: Any = jnp.bfloat16
+    dropout_rate: float = 0.0
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-5, dtype=self.dtype)
+        big = self.stem_strides > 1
+        inc = partial(_Inception, dtype=self.dtype)
+        x = x.astype(self.dtype)
+        x = nn.relu(norm()(conv(
+            64, (7, 7), strides=(2, 2) if big else (1, 1))(x)))
+        if big:
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        x = nn.relu(norm()(conv(64, (1, 1))(x)))
+        x = nn.relu(norm()(conv(192, (3, 3))(x)))
+        if big:
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        x = inc(64, 96, 128, 16, 32, 32)(x, train)     # 3a
+        x = inc(128, 128, 192, 32, 96, 64)(x, train)   # 3b
+        if big:
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        x = inc(192, 96, 208, 16, 48, 64)(x, train)    # 4a
+        x = inc(160, 112, 224, 24, 64, 64)(x, train)   # 4b
+        x = inc(128, 128, 256, 24, 64, 64)(x, train)   # 4c
+        x = inc(112, 144, 288, 32, 64, 64)(x, train)   # 4d
+        x = inc(256, 160, 320, 32, 128, 128)(x, train)  # 4e
+        if big:
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        x = inc(256, 160, 320, 32, 128, 128)(x, train)  # 5a
+        x = inc(384, 192, 384, 48, 128, 128)(x, train)  # 5b
+        x = jnp.mean(x, axis=(1, 2))
+        if self.dropout_rate > 0:
+            x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x.astype(jnp.float32))

@@ -118,3 +118,16 @@ ARCHS: dict = {
     "resnet101": ResNet101,
     "resnet152": ResNet152,
 }
+
+# The reference's imagenet example shipped a zoo beyond ResNet
+# (models/{alex,googlenet,...}.py [uv], SURVEY.md §2.9) — registered here so
+# the CLI accepts them; defined in models/convnets.py (import at the bottom
+# to avoid a cycle: convnets is standalone, ARCHS is the registry).
+from .convnets import AlexNet, GoogLeNet, VGG16  # noqa: E402
+
+ARCHS.update({
+    "alex": AlexNet,
+    "alexnet": AlexNet,
+    "googlenet": GoogLeNet,
+    "vgg16": VGG16,
+})

@@ -41,6 +41,25 @@ def _axis_bound(axis_name) -> bool:
         return False
 
 
+def zeros_like_vma(x, dtype=None, shape=None):
+    """Zeros carrying ``x``'s varying-mesh-axes type.
+
+    Inside ``shard_map``, ``lax.scan`` demands carry-in/out types agree,
+    so accumulators must be *varying* like the data they will absorb — but
+    deriving them as ``x * 0`` would turn a single inf/NaN in ``x`` into an
+    all-NaN accumulator.  This builds honest zeros and pcasts them to
+    ``x``'s vma set instead.
+    """
+    import jax.numpy as jnp
+
+    z = jnp.zeros(x.shape if shape is None else shape,
+                  x.dtype if dtype is None else dtype)
+    vma = getattr(getattr(x, "aval", None), "vma", None)
+    if vma:
+        z = jax.lax.pcast(z, tuple(vma), to="varying")
+    return z
+
+
 def psum(x, axis_name: str = DEFAULT_AXIS_NAME):
     return jax.tree_util.tree_map(lambda v: jax.lax.psum(v, axis_name), x)
 

@@ -223,10 +223,13 @@ def _bwd_blockwise(q, k, v, out, lse, do, causal, scale, block_k, seq_len,
                           preferred_element_type=jnp.float32)
         return dq_acc, (dk_b, dv_b)
 
-    # Derive the accumulator from q (not jnp.zeros) so it inherits q's
-    # varying-axes type — inside shard_map, scan demands carry-in/out agree.
+    # The accumulator must carry q's varying-axes type (scan demands
+    # carry-in/out agree inside shard_map) WITHOUT inheriting q's values —
+    # `q * 0` would smear one inf/NaN in q into an all-NaN dq.
+    from .collective import zeros_like_vma
+
     dq, (dks, dvs) = jax.lax.scan(
-        step, q.astype(jnp.float32) * 0, jnp.arange(nk))
+        step, zeros_like_vma(q, jnp.float32), jnp.arange(nk))
     # (nk, BH, bk, D) → (BH, nk·bk=S, D); blocks were emitted in order.
     dk = dks.transpose(1, 0, 2, 3).reshape(bh, s, d)
     dv = dvs.transpose(1, 0, 2, 3).reshape(bh, s, d)

@@ -29,10 +29,13 @@ from .pipeline import (  # noqa: F401
     stack_stage_params,
 )
 from .hybrid import (  # noqa: F401
+    init_zero1_state,
     make_hybrid_shard_map_step,
     make_hybrid_train_step,
+    make_zero1_train_step,
     shard_pytree,
     state_specs_like,
+    zero1_specs,
 )
 from .transformer import (  # noqa: F401
     init_tp_transformer_lm,
@@ -73,6 +76,9 @@ __all__ = [
     "make_tensor_parallel_mlp",
     "make_hybrid_train_step",
     "make_hybrid_shard_map_step",
+    "make_zero1_train_step",
+    "init_zero1_state",
+    "zero1_specs",
     "shard_pytree",
     "state_specs_like",
     "init_tp_transformer_lm",

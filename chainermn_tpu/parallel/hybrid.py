@@ -107,6 +107,86 @@ def state_specs_like(optimizer: optax.GradientTransformation, params,
         state, is_leaf=params_like)
 
 
+def zero1_specs(params, mesh: Mesh, axis_name: str = "data"):
+    """ZeRO-1 PartitionSpecs: each param-shaped leaf sharded over
+    ``axis_name`` on its first divisible dimension, scalars/indivisible
+    leaves replicated.
+
+    Beyond-reference (the reference replicated optimizer state on every
+    rank): with ``P`` data-parallel chips, Adam's m/v live ``1/P`` per chip.
+    """
+    n = mesh.shape[axis_name]
+
+    def spec_for(leaf):
+        shape = getattr(leaf, "shape", ())
+        for d, s in enumerate(shape):
+            if s % n == 0 and s >= n:
+                return P(*([None] * d + [axis_name]))
+        return P()
+
+    return jax.tree_util.tree_map(spec_for, params)
+
+
+def init_zero1_state(optimizer: optax.GradientTransformation, params,
+                     mesh: Mesh, axis_name: str = "data"):
+    """Optimizer state laid out ZeRO-1: param-shaped subtrees sharded per
+    :func:`zero1_specs`, everything else replicated."""
+    pspecs = zero1_specs(params, mesh, axis_name)
+    sspecs = state_specs_like(optimizer, params, pspecs)
+    shardings = jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), sspecs)
+    return jax.jit(optimizer.init, out_shardings=shardings)(params)
+
+
+def make_zero1_train_step(
+    loss_fn: Callable,
+    optimizer: optax.GradientTransformation,
+    mesh: Mesh,
+    axis_name: str = "data",
+    has_aux: bool = False,
+    donate: bool = True,
+):
+    """ZeRO-1 data-parallel train step (pjit face).
+
+    The gradient all-reduce becomes a REDUCE-SCATTER (each chip receives
+    only its ``1/P`` gradient shard), the optimizer update runs on sharded
+    state (:func:`init_zero1_state`), and the parameter delta is
+    all-gathered back to replicated — reduce_scatter + update/P + all_gather
+    instead of all_reduce + P× redundant update, with optimizer memory cut
+    by ``P``.  All three collectives are GSPMD-inserted from the sharding
+    constraints; params stay replicated at the step boundary so everything
+    else (checkpointing, eval, export) is unchanged.
+    """
+    def step(params, opt_state, batch):
+        pspecs = zero1_specs(params, mesh, axis_name)
+
+        def global_loss(p):
+            out = loss_fn(p, batch)
+            if has_aux:
+                return out
+            return out, None
+
+        (loss, aux), grads = jax.value_and_grad(global_loss, has_aux=True)(params)
+        # Shard the grads like the state: AD's cross-batch reduction + this
+        # constraint lower to one reduce_scatter per leaf.
+        grads = jax.tree_util.tree_map(
+            lambda g, s: jax.lax.with_sharding_constraint(
+                g, NamedSharding(mesh, s)),
+            grads, pspecs)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        # All-gather the delta, keep params replicated at the boundary.
+        updates = jax.tree_util.tree_map(
+            lambda u: jax.lax.with_sharding_constraint(
+                u, NamedSharding(mesh, P())),
+            updates)
+        params = optax.apply_updates(params, updates)
+        if has_aux:
+            return params, opt_state, loss, aux
+        return params, opt_state, loss
+
+    return jax.jit(step, donate_argnums=(0, 1) if donate else ())
+
+
 def make_hybrid_shard_map_step(
     loss_fn: Callable,
     optimizer: optax.GradientTransformation,

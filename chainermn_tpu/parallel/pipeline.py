@@ -39,7 +39,8 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 
 def pipeline_apply(stage_fn: Callable, stage_params, x, *, axis_name: str,
-                   num_microbatches: int, squeeze_stage_axis: bool = True):
+                   num_microbatches: int, squeeze_stage_axis: bool = True,
+                   remat: bool = False):
     """Run ``x`` through ``P`` pipeline stages with GPipe microbatching.
 
     Call INSIDE ``shard_map``.  ``stage_params``: this device's stage slice.
@@ -53,6 +54,12 @@ def pipeline_apply(stage_fn: Callable, stage_params, x, *, axis_name: str,
     """
     p_size = jax.lax.axis_size(axis_name)
     stage = jax.lax.axis_index(axis_name)
+    if remat:
+        # Rematerialized backward: the scan stashes only the tick carries,
+        # stage activations are recomputed — O(M) ride-along activations
+        # become O(1) per stage, the HBM/FLOP trade SURVEY's §2.8 PP note
+        # and the module docstring advertise.
+        stage_fn = jax.checkpoint(stage_fn)
     m = num_microbatches
     if x.shape[0] % m != 0:
         raise ValueError(
@@ -123,7 +130,7 @@ def stack_stage_params(per_stage_params) -> object:
 
 def make_pipeline(stage_fn: Callable, mesh: Optional[Mesh] = None,
                   axis_name: Optional[str] = None,
-                  num_microbatches: int = 8):
+                  num_microbatches: int = 8, remat: bool = False):
     """Eager/jit face: ``fn(stage_stacked_params, x) -> y`` over globals.
 
     ``stage_stacked_params``: pytree whose leaves have leading dim ``P``
@@ -137,7 +144,7 @@ def make_pipeline(stage_fn: Callable, mesh: Optional[Mesh] = None,
     n_stages = mesh.shape[ax]
     inner = make_global_apply(
         partial(pipeline_apply, stage_fn, axis_name=ax,
-                num_microbatches=num_microbatches),
+                num_microbatches=num_microbatches, remat=remat),
         mesh, (P(ax), P()), P())
 
     def apply(stage_stacked_params, x):

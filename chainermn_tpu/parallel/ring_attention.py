@@ -73,8 +73,11 @@ def _ring_flash(q, k, v, axis_name, causal):
                 return local(k_blk, v_blk, True)
 
             def skip(_):
-                return (jnp.zeros_like(o).astype(q.dtype),
-                        jnp.full(lse.shape, _NEG_INF, jnp.float32))
+                # zeros/NEG_INF with the same varying-axes type as the
+                # flash branches (lax.switch demands matching branch types)
+                from ..ops.collective import zeros_like_vma
+                return (zeros_like_vma(o, q.dtype),
+                        zeros_like_vma(lse, jnp.float32) + _NEG_INF)
 
             idx = jnp.where(src < my, 0, jnp.where(src == my, 1, 2))
             out_t, lse_t = jax.lax.switch(idx, [full, diag, skip], None)
@@ -93,8 +96,11 @@ def _ring_flash(q, k, v, axis_name, causal):
         v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
         return (k_blk, v_blk, o, lse_new), None
 
-    o0 = q.astype(jnp.float32) * 0                           # (B, Sq, H, D)
-    lse0 = jnp.swapaxes(o0[..., 0], 1, 2) + _NEG_INF         # (B, H, Sq)
+    from ..ops.collective import zeros_like_vma
+
+    b, s_q, h, d = q.shape
+    o0 = zeros_like_vma(q, jnp.float32)                      # (B, Sq, H, D)
+    lse0 = zeros_like_vma(q, jnp.float32, (b, h, s_q)) + _NEG_INF
     (_, _, o, _), _ = jax.lax.scan(step, (k, v, o0, lse0), jnp.arange(p_size))
     return o.astype(q.dtype)
 
@@ -153,10 +159,12 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
         return (k_blk, v_blk, m_new, l, o), None
 
-    # Accumulators derived from q (not jnp.zeros) so they carry q's
-    # varying-axis type — lax.scan inside shard_map requires carry-in and
-    # carry-out types to agree.
-    o0 = jnp.swapaxes(q, 1, 2).astype(jnp.float32) * 0   # (B, H, Sq, D)
+    # Accumulators must carry q's varying-axis type (lax.scan inside
+    # shard_map requires carry-in and carry-out types to agree) but NOT its
+    # values — `q * 0` would turn one inf/NaN in q into all-NaN output.
+    from ..ops.collective import zeros_like_vma
+
+    o0 = zeros_like_vma(q, jnp.float32, (b, h, s_q, d))  # (B, H, Sq, D)
     l0 = o0[..., 0]                                      # (B, H, Sq)
     m0 = l0 + _NEG_INF
     (_, _, _, l, o), _ = jax.lax.scan(

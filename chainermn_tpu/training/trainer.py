@@ -67,6 +67,11 @@ class Trainer:
         self.observation: Dict[str, Any] = {}
         self._extensions: Dict[str, _Entry] = {}
         self._start_time: Optional[float] = None
+        # Monotonic stamp of the last completed unit of work (a step or any
+        # single extension).  Liveness monitors (extensions.Watchdog) read
+        # this so a slow-but-progressing extension pass is not mistaken for
+        # a hang — only one stuck unit can exceed the timeout.
+        self.last_progress: Optional[float] = None
 
     # ---- passthroughs the extensions read ----
     @property
@@ -119,18 +124,31 @@ class Trainer:
         for e in self._extensions.values():
             if hasattr(e.extension, "initialize"):
                 e.extension.initialize(self)
-        while not self._stopped():
-            self.observation = self.updater.update()
-            for e in sorted(self._extensions.values(),
-                            key=lambda e: -e.priority):
-                # Extensions with an ``observe`` hook see EVERY iteration
-                # (e.g. LogReport folding per-step stats into its means);
-                # ``__call__`` still fires only on the trigger — the same
-                # split Chainer's reporter/summary machinery provided [uv].
-                if hasattr(e.extension, "observe"):
-                    e.extension.observe(self)
-                if e.trigger(self):
-                    e.extension(self)
+        try:
+            while not self._stopped():
+                self.observation = self.updater.update()
+                self.last_progress = time.monotonic()
+                for e in sorted(self._extensions.values(),
+                                key=lambda e: -e.priority):
+                    # Extensions with an ``observe`` hook see EVERY iteration
+                    # (e.g. LogReport folding per-step stats into its means);
+                    # ``__call__`` still fires only on the trigger — the same
+                    # split Chainer's reporter/summary machinery provided [uv].
+                    if hasattr(e.extension, "observe"):
+                        e.extension.observe(self)
+                    if e.trigger(self):
+                        e.extension(self)
+                    self.last_progress = time.monotonic()
+        except BaseException:
+            # Liveness monitors (Watchdog) MUST stop on the exception path —
+            # a still-armed watchdog would os._exit a process that is busy
+            # saving diagnostics.  Everything else keeps the no-finalize-on-
+            # crash contract (see below).
+            for e in self._extensions.values():
+                if (getattr(e.extension, "finalize_on_error", False)
+                        and hasattr(e.extension, "finalize")):
+                    e.extension.finalize()
+            raise
         # Finalize ONLY on clean completion (divergence from Chainer's
         # finally-block [uv], deliberately): extensions like the
         # checkpointer delete their fault-tolerance artifacts in finalize,

@@ -26,7 +26,8 @@ def main():
     parser = argparse.ArgumentParser(description="ChainerMN-TPU example: ImageNet")
     parser.add_argument("--arch", default="resnet50",
                         choices=["resnet18", "resnet34", "resnet50",
-                                 "resnet101", "resnet152"])
+                                 "resnet101", "resnet152",
+                                 "alex", "googlenet", "vgg16"])
     parser.add_argument("--devices", type=int, default=0,
                         help="fake an N-device CPU mesh (0 = real chips)")
     parser.add_argument("--batchsize", type=int, default=64, help="per-chip batch")

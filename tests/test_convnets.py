@@ -1,0 +1,28 @@
+"""Model-zoo registry tests (CPU-cheap half).
+
+Reference parity: ``examples/imagenet/models/{alex,googlenet,...}.py`` [uv]
+(SURVEY.md §2.9) — the reference's ImageNet example accepted a zoo of archs.
+
+The numerical init/forward/train coverage for these archs lives in
+``tests_tpu/test_on_tpu.py::TestModelZoo``: XLA:CPU on this CI box (one
+core) takes minutes to compile a single AlexNet init, while the real chip
+compiles it in seconds — exactly the split the reference used (``@attr.gpu``
+tests ran only where a GPU existed, SURVEY.md §4).
+"""
+
+from chainermn_tpu.models import AlexNet, GoogLeNet, VGG16
+from chainermn_tpu.models.resnet import ARCHS
+
+
+def test_zoo_registered_in_archs():
+    assert ARCHS["alex"] is AlexNet
+    assert ARCHS["alexnet"] is AlexNet
+    assert ARCHS["googlenet"] is GoogLeNet
+    assert ARCHS["vgg16"] is VGG16
+
+
+def test_zoo_constructible_with_standard_knobs():
+    for cls in (AlexNet, GoogLeNet, VGG16):
+        m = cls(num_classes=10, stem_strides=1)
+        assert m.num_classes == 10
+        assert m.dropout_rate == 0.0  # step builders thread no dropout rng

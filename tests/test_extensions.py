@@ -115,13 +115,25 @@ class TestAsyncCheckpointWrites:
         assert it == 3
         np.testing.assert_array_equal(loaded["w"], state["w"])
 
-    def test_writer_error_surfaces_on_next_call(self, comm, tmp_path):
+    def test_unpicklable_state_fails_at_save(self, comm, tmp_path):
+        """Serialization happens on the CALLER thread (a writer-thread
+        pickle would capture live references the train loop mutates), so a
+        bad state fails loudly at save() itself."""
         cp = create_multi_node_checkpointer("job", comm, path=str(tmp_path))
-        cp.save({"bad": lambda: None}, iteration=1)  # unpicklable
         with pytest.raises(Exception, match="pickle|local object"):
-            cp.maybe_load()
-        # the failed generation never materialized
+            cp.save({"bad": lambda: None}, iteration=1)
         assert cp.get_generations() == []
+
+    def test_finalize_cleans_up_even_after_writer_error(self, comm, tmp_path):
+        cp = create_multi_node_checkpointer("job", comm, path=str(tmp_path))
+        cp.save({"x": 1}, iteration=1)
+        cp.flush()
+        # park an artificial writer failure
+        cp._submit(lambda: (_ for _ in ()).throw(OSError("disk gone")))
+        with pytest.raises(OSError, match="disk gone"):
+            cp.finalize()
+        # the cleanup contract ran anyway: no shards left behind
+        assert cp._local_files(any_world_size=True) == []
 
     def test_sync_mode_still_available(self, comm, tmp_path):
         cp = create_multi_node_checkpointer(
@@ -164,6 +176,119 @@ class TestObservationAggregator:
         out = aggregate_observations(obs, comm)
         assert out["loss"] == pytest.approx(2.5)
         assert out["accuracy"] == pytest.approx(0.75)
+
+
+class TestWatchdog:
+    """Hang detection (SURVEY §5: the reference only mitigated deadlocks
+    via the except hook; a silent hang waited forever)."""
+
+    def test_fires_on_stall_and_not_on_heartbeat(self):
+        from chainermn_tpu.extensions import Watchdog
+
+        fired = []
+        wd = Watchdog(timeout=0.3, poll_interval=0.05,
+                      action=lambda gap, to: fired.append((gap, to)))
+        wd.initialize(trainer=None)
+        # heartbeats keep it quiet
+        import time
+        for _ in range(4):
+            time.sleep(0.1)
+            wd.observe(trainer=None)
+        assert not fired
+        # stall → fires once
+        time.sleep(0.6)
+        assert fired and fired[0][0] > 0.3
+        wd.finalize()
+
+    def test_finalize_stops_thread_before_timeout(self):
+        from chainermn_tpu.extensions import Watchdog
+
+        fired = []
+        wd = Watchdog(timeout=0.5, poll_interval=0.05,
+                      action=lambda *a: fired.append(a))
+        wd.initialize(trainer=None)
+        wd.finalize()
+        import time
+        time.sleep(0.7)
+        assert not fired
+
+    def test_slow_but_progressing_extensions_do_not_fire(self):
+        """An extension PASS longer than the timeout is fine as long as each
+        individual unit beats the timeout (trainer.last_progress feeds the
+        watchdog between units)."""
+        import time
+
+        from chainermn_tpu.extensions import Watchdog
+
+        class FakeTrainer:
+            last_progress = None
+
+        fired = []
+        tr = FakeTrainer()
+        wd = Watchdog(timeout=0.3, poll_interval=0.05,
+                      action=lambda *a: fired.append(a))
+        wd.initialize(tr)
+        wd.observe(tr)
+        for _ in range(6):  # 0.9s total, each unit 0.15s < timeout
+            time.sleep(0.15)
+            tr.last_progress = time.monotonic()
+        assert not fired
+        wd.finalize()
+
+    def test_disarmed_when_trainer_crashes(self, comm, tmp_path):
+        """A raised step must stop the watcher thread (finalize_on_error):
+        an armed watchdog would os._exit a process saving diagnostics."""
+        import time
+
+        from chainermn_tpu.extensions import Watchdog
+        from chainermn_tpu.iterators import SerialIterator
+        from chainermn_tpu.training import StandardUpdater, Trainer
+
+        fired = []
+        ds = [(np.zeros((2,), np.float32), 0)] * 16
+
+        def exploding_step(state, batch):
+            raise RuntimeError("boom at step 1")
+
+        trainer = Trainer(
+            StandardUpdater(SerialIterator(ds, 8, shuffle=False),
+                            exploding_step, state=None),
+            (2, "epoch"), out=str(tmp_path))
+        wd = Watchdog(timeout=0.3, poll_interval=0.05,
+                      action=lambda *a: fired.append(a))
+        trainer.extend(wd)
+        with pytest.raises(RuntimeError, match="boom"):
+            trainer.run()
+        time.sleep(0.6)  # past the timeout: a live watcher would have fired
+        assert not fired
+        assert wd._thread is None  # finalize_on_error stopped it
+
+    def test_rejects_bad_timeout(self):
+        from chainermn_tpu.extensions import Watchdog
+
+        with pytest.raises(ValueError):
+            Watchdog(timeout=0)
+
+    def test_composes_with_trainer(self, comm, tmp_path):
+        """A real (fast) training run with a generous watchdog: no fire."""
+        from chainermn_tpu.extensions import Watchdog
+
+        fired = []
+        from chainermn_tpu.iterators import SerialIterator
+        from chainermn_tpu.training import StandardUpdater, Trainer
+
+        ds = [(np.zeros((2,), np.float32), 0)] * 16
+
+        def step_fn(state, batch):
+            return state, {"loss": 0.0}
+
+        it = SerialIterator(ds, 8, shuffle=False)
+        trainer = Trainer(StandardUpdater(it, step_fn, state=None),
+                          (2, "epoch"), out=str(tmp_path))
+        trainer.extend(Watchdog(timeout=60.0,
+                                action=lambda *a: fired.append(a)))
+        trainer.run()
+        assert not fired
 
 
 class TestExceptHook:

@@ -82,13 +82,15 @@ class TestForward:
 
 
 class TestBackward:
-    def test_gradients_match_sequential(self, mesh):
+    @pytest.mark.parametrize("remat", [False, True])
+    def test_gradients_match_sequential(self, mesh, remat):
         """Backward pipeline = scan reversal + ppermute transpose; grads of
-        every stage's weights must equal the single-device chain rule."""
+        every stage's weights must equal the single-device chain rule —
+        with and without rematerialized (jax.checkpoint) stage activations."""
         per_stage = make_params(seed=3)
         stacked = stack_stage_params(per_stage)
         x = np.random.RandomState(4).randn(B, D).astype(np.float32)
-        fn = make_pipeline(stage_fn, mesh=mesh, num_microbatches=4)
+        fn = make_pipeline(stage_fn, mesh=mesh, num_microbatches=4, remat=remat)
 
         got = jax.grad(lambda p: (fn(p, x) ** 2).sum())(stacked)
         want_per_stage = jax.grad(

@@ -89,6 +89,42 @@ class TestOnChipCommunicator:
         np.testing.assert_allclose(np.asarray(comm.allgather(xs)).shape[0], n)
 
 
+class TestModelZoo:
+    """AlexNet / GoogLeNet / VGG16 on the real chip (their CPU compiles
+    take minutes on the 1-core CI box; Mosaic/XLA:TPU takes seconds —
+    the reference's @attr.gpu split, SURVEY.md §4)."""
+
+    @pytest.mark.parametrize("arch", ["alex", "googlenet", "vgg16"])
+    def test_forward_and_grad(self, arch):
+        import optax
+
+        from chainermn_tpu.models.mlp import cross_entropy_loss
+        from chainermn_tpu.models.resnet import ARCHS
+
+        model = ARCHS[arch](num_classes=10, stem_strides=1)
+        variables = dict(model.init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)), train=False))
+        assert "batch_stats" in variables
+
+        comm = mn.create_communicator("xla")
+        opt = mn.create_multi_node_optimizer(optax.sgd(0.1), comm)
+
+        def lam(logits, batch):
+            return cross_entropy_loss(logits, batch[1]), {}
+
+        step = mn.make_flax_train_step(model, lam, opt, mesh=comm.mesh,
+                                       donate=False)
+        variables = mn.replicate(variables, comm.mesh)
+        opt_state = mn.replicate(opt.init(variables["params"]), comm.mesh)
+        rng = np.random.RandomState(0)
+        n = comm.size
+        batch = mn.shard_batch(
+            (rng.randn(4 * n, 32, 32, 3).astype(np.float32),
+             rng.randint(0, 10, 4 * n).astype(np.int32)), comm.mesh)
+        variables, opt_state, loss, _ = step(variables, opt_state, batch)
+        assert np.isfinite(float(loss))
+
+
 class TestOnChipTrainStep:
     @pytest.mark.parametrize("allreduce_grad_dtype", [None, "bfloat16"])
     def test_resnet_step_runs(self, allreduce_grad_dtype):
