@@ -49,8 +49,9 @@ class Watchdog:
     ``action(gap, timeout)`` overrides the abort for testing or custom
     escalation; the default kills the process (and with it the coordinator
     session, so the rest of the gang dies loudly rather than waiting in a
-    collective).  The timer only runs between ``initialize`` and
-    ``finalize`` — setup work before training starts cannot false-trigger.
+    collective).  The timer arms at the FIRST completed unit of work and
+    disarms at ``finalize`` (and on the trainer's exception path) — setup
+    and the first step's arbitrarily-long XLA compile cannot false-trigger.
     """
 
     trigger = (1, "iteration")
@@ -73,8 +74,12 @@ class Watchdog:
 
     # -- extension surface --
     def initialize(self, trainer) -> None:
+        # Armed only from the FIRST completed unit of work: the first
+        # step's XLA compile can legitimately exceed any hang timeout
+        # (big SPMD programs take many minutes), so the clock must not
+        # start at initialize time.
         self._trainer = trainer
-        self._last = time.monotonic()
+        self._last = None
         self._stop.clear()
         self._thread = threading.Thread(
             target=self._watch, name="chainermn-tpu-watchdog", daemon=True)

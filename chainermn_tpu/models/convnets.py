@@ -39,19 +39,25 @@ class AlexNet(nn.Module):
         norm = partial(nn.BatchNorm, use_running_average=not train,
                        momentum=0.9, epsilon=1e-5, dtype=self.dtype)
         big = self.stem_strides > 1
+
+        def pool(y):
+            # Small-input mode still MUST downsample: without it the
+            # flatten below feeds Dense(4096) a 32·32·256 vector — a
+            # ~1B-parameter layer in the mode meant to be cheap.
+            if big:
+                return nn.max_pool(y, (3, 3), strides=(2, 2))
+            if min(y.shape[1:3]) > 4:
+                return nn.max_pool(y, (2, 2), strides=(2, 2))
+            return y
+
         x = x.astype(self.dtype)
         x = conv(64, (11, 11) if big else (3, 3),
                  strides=(4, 4) if big else (1, 1))(x)
-        x = nn.relu(norm()(x))
-        if big:
-            x = nn.max_pool(x, (3, 3), strides=(2, 2))
-        x = nn.relu(norm()(conv(192, (5, 5))(x)))
-        if big:
-            x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = pool(nn.relu(norm()(x)))
+        x = pool(nn.relu(norm()(conv(192, (5, 5))(x))))
         x = nn.relu(norm()(conv(384, (3, 3))(x)))
         x = nn.relu(norm()(conv(256, (3, 3))(x)))
-        x = nn.relu(norm()(conv(256, (3, 3))(x)))
-        x = nn.max_pool(x, (3, 3), strides=(2, 2)) if big else x
+        x = pool(nn.relu(norm()(conv(256, (3, 3))(x))))
         x = x.reshape((x.shape[0], -1))
         x = nn.relu(nn.Dense(4096, dtype=self.dtype)(x))
         x = self._drop(x, train)

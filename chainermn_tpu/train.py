@@ -63,6 +63,48 @@ def _value_and_global_grads(local_loss, params, axis_name,
     return (jax.lax.pmean(loss, axis_name), aux), grads
 
 
+def _accumulated_local_grads(local_loss, params, batch, axis_name, steps):
+    """Mean LOCAL loss/grads over ``steps`` microbatches via ``lax.scan``.
+
+    Each microbatch's backward runs with only its own activations live
+    (O(B/steps) instead of O(B)); gradients accumulate in fp32.  Returned
+    grads are still per-rank local (varying) — the caller owns the one wire
+    collective, exactly like the compressed path of
+    :func:`_value_and_global_grads`.  ``local_loss(p, microbatch)`` must
+    return ``(loss, aux)``; aux is averaged over microbatches.
+    """
+    import jax.numpy as jnp
+
+    from .ops.collective import zeros_like_vma
+
+    b_local = jax.tree_util.tree_leaves(batch)[0].shape[0]
+    if b_local % steps:
+        raise ValueError(
+            f"per-rank batch {b_local} not divisible by "
+            f"grad_accum_steps {steps}")
+    micro = jax.tree_util.tree_map(
+        lambda x: x.reshape((steps, x.shape[0] // steps) + x.shape[1:]), batch)
+    p_local = jax.tree_util.tree_map(
+        lambda v: jax.lax.pcast(v, axis_name, to="varying"), params)
+    any_leaf = jax.tree_util.tree_leaves(p_local)[0]
+
+    def acc(carry, mb):
+        g_acc, l_acc = carry
+        (l, aux), g = jax.value_and_grad(local_loss, has_aux=True)(p_local, mb)
+        g_acc = jax.tree_util.tree_map(
+            lambda a, gg: a + gg.astype(jnp.float32), g_acc, g)
+        return (g_acc, l_acc + l), aux
+
+    g0 = jax.tree_util.tree_map(
+        lambda v: zeros_like_vma(v, jnp.float32), p_local)
+    l0 = zeros_like_vma(any_leaf, jnp.float32, ())
+    (g_sum, l_sum), aux_stack = jax.lax.scan(acc, (g0, l0), micro)
+    grads = jax.tree_util.tree_map(lambda g: g / steps, g_sum)
+    aux = jax.tree_util.tree_map(
+        lambda x: x.astype(jnp.float32).mean(0), aux_stack)
+    return (l_sum / steps, aux), grads
+
+
 def make_train_step(
     loss_fn: Callable,
     optimizer: optax.GradientTransformation,
@@ -72,6 +114,7 @@ def make_train_step(
     donate: bool = True,
     allreduce_grad_dtype=None,
     grad_reduce: Optional[Callable] = None,
+    grad_accum_steps: int = 1,
 ):
     """Build ``step(params, opt_state, batch) -> (params, opt_state, loss[, aux])``.
 
@@ -88,19 +131,38 @@ def make_train_step(
     dominant communication — runs in that dtype on the wire, halving ICI/DCN
     gradient bytes for bf16, with params and the optimizer update staying at
     full precision.
+
+    ``grad_accum_steps > 1`` splits each rank's local batch into that many
+    microbatches and accumulates their gradients in fp32 via ``lax.scan``
+    before the ONE cross-rank mean and optimizer update — activation memory
+    drops by the factor while the wire traffic per update is unchanged
+    (beyond-reference: large effective batches on small HBM).
     """
     if mesh is None:
         mesh = make_mesh(axis_name=axis_name)
 
+    if grad_accum_steps < 1:
+        raise ValueError(f"grad_accum_steps must be >= 1, got {grad_accum_steps}")
+
     def spmd(params, opt_state, batch):
-        def local_loss(p):
-            out = loss_fn(p, batch)
+        def local_loss(p, b):
+            out = loss_fn(p, b)
             if has_aux:
                 return out
             return out, None
 
-        (loss, aux), grads = _value_and_global_grads(
-            local_loss, params, axis_name, allreduce_grad_dtype, grad_reduce)
+        if grad_accum_steps == 1:
+            (loss, aux), grads = _value_and_global_grads(
+                lambda p: local_loss(p, batch), params, axis_name,
+                allreduce_grad_dtype, grad_reduce)
+        else:
+            (loss, aux), grads = _accumulated_local_grads(
+                local_loss, params, batch, axis_name, grad_accum_steps)
+            if grad_reduce is not None:
+                grads = grad_reduce(grads)
+            else:
+                grads = compressed_mean(grads, axis_name, allreduce_grad_dtype)
+            loss = jax.lax.pmean(loss, axis_name)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         if has_aux:

@@ -194,6 +194,53 @@ def test_compressed_step_matches_bf16_oracle():
         "is not reaching the wire collective")
 
 
+@pytest.mark.parametrize("dtype", [None, "bfloat16"])
+def test_grad_accumulation_matches_full_batch(dtype):
+    """grad_accum_steps=4: microbatched fp32 accumulation + one wire mean
+    equals the full-batch step (optionally bf16-compressed)."""
+    mesh, batch = make_mesh_and_sharded_batch()
+    opt = mn.create_multi_node_optimizer(
+        optax.sgd(0.1), mn.create_communicator("xla"),
+        allreduce_grad_dtype=dtype)
+    kw = dict(mesh=mesh, donate=False, allreduce_grad_dtype=dtype)
+    full = mn.make_train_step(loss_fn, opt, **kw)
+    accum = mn.make_train_step(loss_fn, opt, grad_accum_steps=4, **kw)
+
+    outs = []
+    for step in (full, accum):
+        params = mn.replicate(init_params(), mesh)
+        st = mn.replicate(opt.init(params), mesh)
+        p, _, loss = step(params, st, mn.shard_batch(batch, mesh))
+        outs.append((p, float(loss)))
+    (p_full, l_full), (p_acc, l_acc) = outs
+    np.testing.assert_allclose(l_full, l_acc, rtol=1e-5)
+    for k in p_full:
+        np.testing.assert_allclose(
+            np.asarray(p_full[k]), np.asarray(p_acc[k]), rtol=1e-5,
+            atol=2e-7 if dtype is None else 1e-3)
+
+
+def test_grad_accumulation_with_aux():
+    mesh, batch = make_mesh_and_sharded_batch()
+    opt = mn.create_multi_node_optimizer(optax.sgd(0.1), mn.create_communicator("xla"))
+
+    def loss_aux(params, b):
+        l = loss_fn(params, b)
+        return l, {"l2": l * 2}
+
+    step = mn.make_train_step(loss_aux, opt, mesh=mesh, has_aux=True,
+                              donate=False, grad_accum_steps=2)
+    params = mn.replicate(init_params(), mesh)
+    st = mn.replicate(opt.init(params), mesh)
+    p, _, loss, aux = step(params, st, mn.shard_batch(batch, mesh))
+    np.testing.assert_allclose(float(aux["l2"]), 2 * float(loss), rtol=1e-5)
+
+
+def test_grad_accumulation_rejects_bad_steps():
+    with pytest.raises(ValueError, match="grad_accum_steps"):
+        mn.make_train_step(loss_fn, optax.sgd(0.1), grad_accum_steps=0)
+
+
 def test_double_buffering_requires_zero_fill():
     with pytest.raises(NotImplementedError):
         opt = mn.create_multi_node_optimizer(
