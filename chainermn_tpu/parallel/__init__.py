@@ -39,6 +39,8 @@ from .hybrid import (  # noqa: F401
 )
 from .transformer import (  # noqa: F401
     init_tp_transformer_lm,
+    sp_block,
+    sp_transformer_lm_loss,
     tp_attention,
     tp_block,
     tp_transformer_lm_loss,
@@ -82,6 +84,8 @@ __all__ = [
     "shard_pytree",
     "state_specs_like",
     "init_tp_transformer_lm",
+    "sp_block",
+    "sp_transformer_lm_loss",
     "tp_attention",
     "tp_block",
     "tp_transformer_lm_loss",

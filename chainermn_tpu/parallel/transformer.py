@@ -142,6 +142,83 @@ def tp_transformer_lm_loss(params, batch, *, head_dim: int, axis_name: str,
                                       axis_name=axis_name)
 
 
+def sp_block(x, params, *, head_dim: int, axis_name: str, causal: bool = True,
+             attn_impl: str = "xla"):
+    """Transformer block with the SEQUENCE sharded over ``axis_name``.
+
+    The long-context configuration (first-class per the rebuild brief;
+    absent from the 2017 reference — SURVEY.md §5): ``x`` is the local
+    sequence shard ``(B, S/P, D)`` with params REPLICATED; attention runs
+    ring-wise over the ICI ring (O(S/P) K/V memory per chip, flash local
+    blocks), everything else (LN, MLP) is embarrassingly parallel over
+    sequence positions.  Uses the same (unsharded) block-param layout as
+    :func:`init_tp_transformer_lm` — the head-major wqkv makes the local
+    reshape identical to :func:`tp_attention`'s.
+    """
+    from .ring_attention import ring_attention
+
+    b, s_local, d = x.shape
+    n_heads = d // head_dim
+    a = params["attn"]
+    h = _layer_norm(x, params["ln1_scale"], params["ln1_bias"])
+    qkv = jnp.matmul(h, a["wqkv"],
+                     preferred_element_type=jnp.float32).astype(x.dtype)
+    qkv = (qkv + a["bqkv"]).reshape(b, s_local, n_heads, 3, head_dim)
+    q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
+    ctx = ring_attention(q, k, v, axis_name=axis_name, causal=causal,
+                         attn_impl=attn_impl)
+    ctx = ctx.reshape(b, s_local, d)
+    attn_out = jnp.matmul(ctx, a["wo"],
+                          preferred_element_type=jnp.float32).astype(x.dtype)
+    x = x + attn_out + a["bo"]
+    h = _layer_norm(x, params["ln2_scale"], params["ln2_bias"])
+    mlp = params["mlp"]
+    y = jax.nn.gelu(jnp.matmul(h, mlp["wi"],
+                    preferred_element_type=jnp.float32).astype(x.dtype)
+                    + mlp["bi"])
+    y = jnp.matmul(y, mlp["wo"],
+                   preferred_element_type=jnp.float32).astype(x.dtype)
+    return x + y + mlp["bo"]
+
+
+def sp_transformer_lm_loss(params, batch, *, head_dim: int, axis_name: str,
+                           causal: bool = True, attn_impl: str = "xla"):
+    """Per-token mean NLL with the SEQUENCE sharded over ``axis_name``.
+
+    ``batch``: ``(inputs (B, S/P), targets (B, S/P))`` — the caller shards
+    a ``(B, S)`` token array over its sequence axis (``P(None, axis)``) and
+    shifts globally BEFORE sharding, so each chip's targets line up with
+    its inputs.  Params replicated; the ring carries the only cross-chip
+    traffic.  Gradient sync composes exactly like data parallelism: pmean
+    the loss over the axis and let autodiff insert the cotangent psum.
+    """
+    inputs, targets = batch
+    my = jax.lax.axis_index(axis_name)
+    s_local = inputs.shape[1]
+    s_global = jax.lax.axis_size(axis_name) * s_local
+    max_len = params["pos_embed"].shape[0]
+    if s_global > max_len:
+        # jnp.take would silently CLAMP out-of-range positions to the last
+        # pos_embed row — degenerate positional info, no error.  Fail loud.
+        raise ValueError(
+            f"global sequence {s_global} exceeds pos_embed max_len "
+            f"{max_len}; re-init the model with max_len >= {s_global}")
+
+    x = jnp.take(params["embed"], inputs, axis=0)
+    x = x * (params["embed"].shape[1] ** 0.5)
+    pos = my * s_local + jnp.arange(s_local)
+    x = x + jnp.take(params["pos_embed"], pos, axis=0)[None]
+    for blk in params["blocks"]:
+        x = sp_block(x, blk, head_dim=head_dim, axis_name=axis_name,
+                     causal=causal, attn_impl=attn_impl)
+    x = _layer_norm(x, params["lnf_scale"], params["lnf_bias"])
+    logits = jnp.einsum("bsd,vd->bsv", x, params["embed"],
+                        preferred_element_type=jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(nll)
+
+
 # ---- init + specs (GLOBAL params; shard with transformer_lm_specs) ----
 
 def init_tp_transformer_lm(rng, vocab: int, d_model: int, n_heads: int,
