@@ -143,19 +143,22 @@ def tp_transformer_lm_loss(params, batch, *, head_dim: int, axis_name: str,
 
 
 def sp_block(x, params, *, head_dim: int, axis_name: str, causal: bool = True,
-             attn_impl: str = "xla"):
+             attn_impl: str = "xla", sp_impl: str = "ring"):
     """Transformer block with the SEQUENCE sharded over ``axis_name``.
 
     The long-context configuration (first-class per the rebuild brief;
     absent from the 2017 reference — SURVEY.md §5): ``x`` is the local
     sequence shard ``(B, S/P, D)`` with params REPLICATED; attention runs
-    ring-wise over the ICI ring (O(S/P) K/V memory per chip, flash local
-    blocks), everything else (LN, MLP) is embarrassingly parallel over
-    sequence positions.  Uses the same (unsharded) block-param layout as
-    :func:`init_tp_transformer_lm` — the head-major wqkv makes the local
-    reshape identical to :func:`tp_attention`'s.
+    over ``sp_impl`` — ``'ring'`` (ppermute K/V rotation, O(S/P) keys per
+    chip, any head count) or ``'ulysses'`` (two all-to-alls swapping the
+    sharded axis to heads; needs ``n_heads % P == 0``).  Everything else
+    (LN, MLP) is embarrassingly parallel over sequence positions.  Uses the
+    same (unsharded) block-param layout as :func:`init_tp_transformer_lm` —
+    the head-major wqkv makes the local reshape identical to
+    :func:`tp_attention`'s.
     """
     from .ring_attention import ring_attention
+    from .ulysses import ulysses_attention
 
     b, s_local, d = x.shape
     n_heads = d // head_dim
@@ -165,8 +168,14 @@ def sp_block(x, params, *, head_dim: int, axis_name: str, causal: bool = True,
                      preferred_element_type=jnp.float32).astype(x.dtype)
     qkv = (qkv + a["bqkv"]).reshape(b, s_local, n_heads, 3, head_dim)
     q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
-    ctx = ring_attention(q, k, v, axis_name=axis_name, causal=causal,
-                         attn_impl=attn_impl)
+    if sp_impl == "ring":
+        ctx = ring_attention(q, k, v, axis_name=axis_name, causal=causal,
+                             attn_impl=attn_impl)
+    elif sp_impl == "ulysses":
+        ctx = ulysses_attention(q, k, v, axis_name=axis_name, causal=causal,
+                                attn_impl=attn_impl)
+    else:
+        raise ValueError(f"sp_impl must be 'ring' or 'ulysses', got {sp_impl!r}")
     ctx = ctx.reshape(b, s_local, d)
     attn_out = jnp.matmul(ctx, a["wo"],
                           preferred_element_type=jnp.float32).astype(x.dtype)
@@ -182,7 +191,8 @@ def sp_block(x, params, *, head_dim: int, axis_name: str, causal: bool = True,
 
 
 def sp_transformer_lm_loss(params, batch, *, head_dim: int, axis_name: str,
-                           causal: bool = True, attn_impl: str = "xla"):
+                           causal: bool = True, attn_impl: str = "xla",
+                           sp_impl: str = "ring"):
     """Per-token mean NLL with the SEQUENCE sharded over ``axis_name``.
 
     ``batch``: ``(inputs (B, S/P), targets (B, S/P))`` — the caller shards
@@ -210,7 +220,7 @@ def sp_transformer_lm_loss(params, batch, *, head_dim: int, axis_name: str,
     x = x + jnp.take(params["pos_embed"], pos, axis=0)[None]
     for blk in params["blocks"]:
         x = sp_block(x, blk, head_dim=head_dim, axis_name=axis_name,
-                     causal=causal, attn_impl=attn_impl)
+                     causal=causal, attn_impl=attn_impl, sp_impl=sp_impl)
     x = _layer_norm(x, params["lnf_scale"], params["lnf_bias"])
     logits = jnp.einsum("bsd,vd->bsv", x, params["embed"],
                         preferred_element_type=jnp.float32)

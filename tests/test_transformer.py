@@ -86,7 +86,7 @@ class TestSequenceParallelLM:
     """Long-context face: sequence sharded over the mesh, ring attention
     carrying the only cross-chip traffic, params replicated."""
 
-    def _loss_and_grads(self, n_shards, attn_impl, devices):
+    def _loss_and_grads(self, n_shards, attn_impl, devices, sp_impl="ring"):
         from chainermn_tpu.parallel import sp_transformer_lm_loss
 
         params = init_tp_transformer_lm(
@@ -96,7 +96,8 @@ class TestSequenceParallelLM:
         inputs, targets = tokens[:, :-1], tokens[:, 1:]  # shift BEFORE shard
         mesh = mn.make_mesh(devices[:n_shards], axis_name="sp")
         loss_fn = partial(sp_transformer_lm_loss, head_dim=HEAD_DIM,
-                          axis_name="sp", attn_impl=attn_impl)
+                          axis_name="sp", attn_impl=attn_impl,
+                          sp_impl=sp_impl)
 
         def spmd(p, b):
             return jax.lax.pmean(loss_fn(p, b), "sp")
@@ -122,6 +123,17 @@ class TestSequenceParallelLM:
     def test_sane_nll(self, devices):
         l8, _ = self._loss_and_grads(8, "xla", devices)
         assert abs(l8 - np.log(VOCAB)) < 1.5, l8
+
+    def test_ulysses_sp_matches_oracle(self, devices):
+        """sp_impl='ulysses' (head↔seq all-to-alls) on 4 shards (HEADS=4
+        divisible) == unsharded oracle."""
+        l1, g1 = self._loss_and_grads(1, "xla", devices)
+        l4, g4 = self._loss_and_grads(4, "xla", devices, sp_impl="ulysses")
+        np.testing.assert_allclose(l1, l4, rtol=2e-5)
+        for a, b in zip(jax.tree_util.tree_leaves(g1),
+                        jax.tree_util.tree_leaves(g4)):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=5e-4, atol=5e-6)
 
 
 class TestTraining:
