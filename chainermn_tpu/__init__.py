@@ -55,6 +55,7 @@ from .train import (  # noqa: F401
     make_train_step,
     replicate,
     shard_batch,
+    shard_batch_local,
 )
 from .communicators import (  # noqa: F401
     CommunicatorBase,

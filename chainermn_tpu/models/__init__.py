@@ -1,2 +1,3 @@
 from .convnets import AlexNet, GoogLeNet, VGG16  # noqa: F401
 from .mlp import MLP, accuracy, cross_entropy_loss  # noqa: F401
+from .vit import ViT, ViT_B16, ViT_S16, ViT_Ti16  # noqa: F401

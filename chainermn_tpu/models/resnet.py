@@ -131,3 +131,11 @@ ARCHS.update({
     "googlenet": GoogLeNet,
     "vgg16": VGG16,
 })
+
+from .vit import ViT_B16, ViT_S16, ViT_Ti16  # noqa: E402
+
+ARCHS.update({
+    "vit_ti16": ViT_Ti16,
+    "vit_s16": ViT_S16,
+    "vit_b16": ViT_B16,
+})

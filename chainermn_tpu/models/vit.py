@@ -1,0 +1,116 @@
+"""Vision Transformer (ViT-S/B) — the modern imagenet family.
+
+Beyond-reference (the reference's zoo — SURVEY.md §2.9 — is 2017-era
+convnets): a patch-embedding transformer classifier built TPU-first:
+
+* NHWC patchify as ONE conv (stride = patch) → big MXU matmuls throughout;
+* bf16 compute / fp32 params, matching the convnet conventions in this
+  package;
+* attention can run through the in-tree Pallas flash kernel
+  (``attn_impl='flash'``) — online-softmax VMEM scratch instead of the
+  O(S²) score matrix — or plain XLA einsum (``'xla'``, the default, which
+  XLA fuses fine at classification sequence lengths).
+
+Interface matches the zoo: ``(x, train=...) -> logits``, ``stem_strides``
+accepted (ignored — patch size already scales with input), registered in
+``resnet.ARCHS`` for the imagenet CLI.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+ModuleDef = Any
+
+
+class _MHSA(nn.Module):
+    """Multi-head self-attention over (B, S, D), optional flash kernel."""
+
+    num_heads: int
+    dtype: Any = jnp.bfloat16
+    attn_impl: str = "xla"
+
+    @nn.compact
+    def __call__(self, x):
+        b, s, d = x.shape
+        h = self.num_heads
+        qkv = nn.DenseGeneral((3, h, d // h), dtype=self.dtype, name="qkv")(x)
+        q, k, v = (qkv[:, :, i] for i in range(3))  # each (B, S, H, Dh)
+        if self.attn_impl == "flash":
+            from ..ops import flash_attention
+
+            o = flash_attention(q, k, v)
+        else:
+            scale = (d // h) ** -0.5
+            att = jnp.einsum("bqhc,bkhc->bhqk", q, k) * scale
+            att = nn.softmax(att.astype(jnp.float32)).astype(self.dtype)
+            o = jnp.einsum("bhqk,bkhc->bqhc", att, v)
+        return nn.DenseGeneral(d, axis=(-2, -1), dtype=self.dtype,
+                               name="proj")(o)
+
+
+class _Block(nn.Module):
+    num_heads: int
+    mlp_ratio: int = 4
+    dtype: Any = jnp.bfloat16
+    attn_impl: str = "xla"
+
+    @nn.compact
+    def __call__(self, x):
+        d = x.shape[-1]
+        y = nn.LayerNorm(dtype=jnp.float32)(x)
+        x = x + _MHSA(self.num_heads, self.dtype, self.attn_impl)(y)
+        y = nn.LayerNorm(dtype=jnp.float32)(x)
+        y = nn.Dense(d * self.mlp_ratio, dtype=self.dtype)(y)
+        y = nn.gelu(y)
+        return x + nn.Dense(d, dtype=self.dtype)(y)
+
+
+class ViT(nn.Module):
+    """ViT classifier; defaults are ViT-S/16 shaped."""
+
+    num_classes: int = 1000
+    patch: int = 16
+    d_model: int = 384
+    depth: int = 12
+    num_heads: int = 6
+    dtype: Any = jnp.bfloat16
+    attn_impl: str = "xla"
+    stem_strides: int = 2  # accepted for zoo-interface parity; unused
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        del train  # no dropout in the baseline recipe; BN-free by design
+        b, hgt, wid, _ = x.shape
+        p = self.patch
+        if hgt < p or wid < p:
+            raise ValueError(
+                f"input {hgt}x{wid} smaller than patch {p}; construct the "
+                f"model with a smaller patch= (silently reconfiguring would "
+                f"change the pos_embed shape and break checkpoints)")
+        x = nn.Conv(self.d_model, (p, p), strides=(p, p),
+                    dtype=self.dtype, name="patch_embed")(x)
+        x = x.reshape(b, -1, self.d_model)
+        cls = self.param("cls", nn.initializers.zeros, (1, 1, self.d_model))
+        x = jnp.concatenate(
+            [jnp.broadcast_to(cls, (b, 1, self.d_model)).astype(x.dtype), x],
+            axis=1)
+        pos = self.param("pos_embed",
+                         nn.initializers.normal(stddev=0.02),
+                         (1, x.shape[1], self.d_model))
+        x = x + pos.astype(x.dtype)
+        for _ in range(self.depth):
+            x = _Block(self.num_heads, dtype=self.dtype,
+                       attn_impl=self.attn_impl)(x)
+        x = nn.LayerNorm(dtype=jnp.float32)(x)
+        # classify on the CLS token; head in fp32 like the convnets
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x[:, 0])
+
+
+ViT_S16 = partial(ViT, patch=16, d_model=384, depth=12, num_heads=6)
+ViT_B16 = partial(ViT, patch=16, d_model=768, depth=12, num_heads=12)
+ViT_Ti16 = partial(ViT, patch=16, d_model=192, depth=12, num_heads=3)

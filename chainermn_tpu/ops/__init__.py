@@ -5,12 +5,14 @@ from .collective import (  # noqa: F401
     axis_index,
     axis_size,
     bcast,
+    hierarchical_pmean,
     pmax,
     pmean,
     pmean_if_bound,
     pmin,
     ppermute,
     psum,
+    quantized_ring_pmean,
     reduce_scatter,
     shift,
 )

@@ -132,6 +132,84 @@ def bcast(x, root: int = 0, axis_name: str = DEFAULT_AXIS_NAME):
     return jax.tree_util.tree_map(one, x)
 
 
+def quantized_ring_pmean(x, axis_name: str = DEFAULT_AXIS_NAME,
+                         wire_dtype="int8"):
+    """Cross-rank mean with **int8 wire traffic**: a hand-scheduled ring
+    all-reduce (reduce-scatter + all-gather over ``ppermute``) where every
+    hop carries ``wire_dtype`` payloads plus one fp32 scale per chunk.
+
+    Beyond the reference's fp16 ``allreduce_grad_dtype`` (its best was 2
+    bytes/element; this is ~1): the EQuARX recipe (PAPERS.md) — block
+    quantization with requantization at each reduce-scatter hop, a single
+    quantization for the all-gather phase.  Deterministic symmetric
+    quantization: ``q = round(v * 127 / max|v|)``, error per hop ≤
+    ``max|v|/254``, compounding over ``P-1`` hops — use for gradients (noise-
+    tolerant), not for activations.
+
+    Call inside ``shard_map`` with ``axis_name`` bound.  Works per-leaf on a
+    pytree.  Chunk layout pads ``x`` to a multiple of the axis size.
+    """
+    import jax.numpy as jnp
+
+    p = jax.lax.axis_size(axis_name)
+    if p == 1:
+        return x
+    wire = jnp.dtype(wire_dtype)
+    if not jnp.issubdtype(wire, jnp.integer):
+        raise ValueError(f"wire_dtype must be an integer type, got {wire}")
+    qmax = float(jnp.iinfo(wire).max)  # symmetric: use [-qmax, qmax]
+    idx = jax.lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % p) for i in range(p)]
+
+    def quant(v):
+        scale = jnp.maximum(jnp.max(jnp.abs(v)), 1e-30) / qmax
+        q = jnp.clip(jnp.round(v / scale), -qmax, qmax).astype(wire)
+        return q, scale.astype(jnp.float32)
+
+    def one(leaf):
+        flat = leaf.ravel().astype(jnp.float32)
+        n = flat.shape[0]
+        flat = jnp.pad(flat, (0, (-n) % p))
+        chunks = flat.reshape(p, -1)
+
+        # Reduce-scatter: at step s rank i forwards its running sum for
+        # chunk (i - s) mod p; after P-1 hops rank i holds the full sum of
+        # chunk (i + 1) mod p.  Each hop re-quantizes the running sum.
+        send = jax.lax.dynamic_index_in_dim(chunks, idx, 0, keepdims=False)
+        for s in range(p - 1):
+            q, scale = quant(send)
+            q = jax.lax.ppermute(q, axis_name, perm=perm)
+            scale = jax.lax.ppermute(scale, axis_name, perm=perm)
+            c = jnp.mod(idx - s - 1, p)
+            send = (q.astype(jnp.float32) * scale
+                    + jax.lax.dynamic_index_in_dim(chunks, c, 0,
+                                                   keepdims=False))
+
+        # All-gather phase: ONE quantization, then a psum of a one-hot row
+        # buffer (rank r contributes its finished chunk at row r, zeros
+        # elsewhere).  Every element has exactly ONE nonzero contributor, so
+        # the int8 sum cannot overflow, the wire stays ~1 byte/element, and
+        # — unlike ``all_gather`` or a ppermute gather ring, whose outputs
+        # the shard_map VMA checker types as axis-varying — a psum is
+        # provably replication-invariant, so the result can flow to
+        # ``out_specs=P()`` (replicated params) without extra collectives.
+        q, scale = quant(send)
+        buf_q = jnp.zeros((p,) + q.shape, q.dtype)
+        buf_q = jax.lax.dynamic_update_index_in_dim(buf_q, q, idx, axis=0)
+        buf_s = jnp.zeros((p,), jnp.float32)
+        buf_s = jax.lax.dynamic_update_index_in_dim(buf_s, scale, idx, axis=0)
+        gq = jax.lax.psum(buf_q, axis_name)
+        gs = jax.lax.psum(buf_s, axis_name)
+        # Rank r finished chunk (r+1) mod p, so row r holds chunk (r+1);
+        # rolling down one row puts chunk c at row c.
+        deq = jnp.roll(gq.astype(jnp.float32) * gs[:, None], 1, axis=0)
+
+        flat_out = deq.ravel()[:n] / p
+        return flat_out.reshape(leaf.shape).astype(leaf.dtype)
+
+    return jax.tree_util.tree_map(one, x)
+
+
 def hierarchical_pmean(x, chip_axis: str = "chip", slice_axis: str = "slice",
                        dcn_dtype=None):
     """Two-tier mean over a ``('slice', 'chip')`` multislice mesh.

@@ -66,11 +66,42 @@ def compressed_mean(grads, axis_name: Optional[str], allreduce_grad_dtype=None):
     Each leaf is cast back to its original dtype after the reduction, so the
     optimizer update always runs at model precision.
     """
+    def already_reduced(g):
+        # Provably replication-invariant over the axis (shard_map VMA type):
+        # a second reduction would be pure wasted wire — the train-step
+        # builders reduce local grads themselves, then hand the result to
+        # the optax wrapper, which must not reduce AGAIN.  No vma attribute
+        # (pmap, older tracers) proves nothing → reduce.
+        vma = getattr(getattr(g, "aval", None), "vma", None)
+        if vma is None:
+            return False
+        names = (axis_name if isinstance(axis_name, (tuple, list))
+                 else (axis_name,))
+        return not any(n in vma for n in names)
+
     if allreduce_grad_dtype is None:
-        return pmean_if_bound(grads, axis_name)
+        return jax.tree_util.tree_map(
+            lambda g: g if already_reduced(g) else pmean_if_bound(g, axis_name),
+            grads)
     wire = jnp.dtype(allreduce_grad_dtype)
 
+    if jnp.issubdtype(wire, jnp.integer):
+        # int8 path: a hand-scheduled quantized ring all-reduce (~1
+        # byte/element on the wire vs the reference's 2-byte fp16 best).
+        # Needs a bound axis — the quantized schedule is explicit ppermutes;
+        # under plain pjit (unbound axis) the gradients are already globally
+        # reduced and there is no wire leg left to compress.
+        from .ops.collective import quantized_ring_pmean
+
+        if axis_name is None or not _axis_bound(axis_name):
+            return grads
+        return jax.tree_util.tree_map(
+            lambda g: g if already_reduced(g)
+            else quantized_ring_pmean(g, axis_name, wire), grads)
+
     def one(g):
+        if already_reduced(g):
+            return g
         return pmean_if_bound(g.astype(wire), axis_name).astype(g.dtype)
 
     return jax.tree_util.tree_map(one, grads)

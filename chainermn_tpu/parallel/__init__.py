@@ -25,11 +25,16 @@ from .moe import (  # noqa: F401
 )
 from .pipeline import (  # noqa: F401
     make_pipeline,
+    make_pipeline_1f1b,
+    pipeline_1f1b_grads,
     pipeline_apply,
     stack_stage_params,
 )
 from .hybrid import (  # noqa: F401
+    init_fsdp_params,
+    init_fsdp_state,
     init_zero1_state,
+    make_fsdp_train_step,
     make_hybrid_shard_map_step,
     make_hybrid_train_step,
     make_zero1_train_step,
@@ -65,6 +70,8 @@ __all__ = [
     "pipeline_apply",
     "stack_stage_params",
     "make_pipeline",
+    "make_pipeline_1f1b",
+    "pipeline_1f1b_grads",
     "moe_mlp",
     "init_moe_mlp_params",
     "moe_mlp_specs",
@@ -79,6 +86,9 @@ __all__ = [
     "make_hybrid_train_step",
     "make_hybrid_shard_map_step",
     "make_zero1_train_step",
+    "make_fsdp_train_step",
+    "init_fsdp_params",
+    "init_fsdp_state",
     "init_zero1_state",
     "zero1_specs",
     "shard_pytree",

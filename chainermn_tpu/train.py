@@ -239,8 +239,35 @@ def replicate(tree, mesh: Optional[Mesh] = None):
 
 
 def shard_batch(batch, mesh: Optional[Mesh] = None, axis_name: str = DEFAULT_AXIS_NAME):
-    """Shard a host batch's leading axis across the mesh (rank-major)."""
+    """Shard a host batch's leading axis across the mesh (rank-major).
+
+    Single-controller face: every process holds the FULL global batch.
+    Under multi-controller (one process per host), use
+    :func:`shard_batch_local` instead — each host only loads its own rows.
+    """
     if mesh is None:
         mesh = make_mesh(axis_name=axis_name)
     sharding = NamedSharding(mesh, P(axis_name))
     return jax.tree_util.tree_map(lambda x: jax.device_put(x, sharding), batch)
+
+
+def shard_batch_local(local_batch, mesh: Optional[Mesh] = None,
+                      axis_name: str = DEFAULT_AXIS_NAME):
+    """Assemble a globally-sharded batch from per-process LOCAL rows.
+
+    The multi-controller input path (reference analog: each MPI rank feeds
+    its own ``scatter_dataset`` shard straight to its GPU — SURVEY.md §3.4):
+    each process passes only the rows its own devices will hold (e.g. the
+    output of ``scatter_dataset(...)`` + a local iterator), and the result
+    is one global jax.Array whose leading axis is the concatenation over
+    processes, without any cross-host data movement.
+
+    Works single-process too (where it equals :func:`shard_batch`), so the
+    same input code runs on a laptop mesh and a pod.
+    """
+    if mesh is None:
+        mesh = make_mesh(axis_name=axis_name)
+    sharding = NamedSharding(mesh, P(axis_name))
+    return jax.tree_util.tree_map(
+        lambda x: jax.make_array_from_process_local_data(sharding, x),
+        local_batch)

@@ -40,10 +40,18 @@ def main():
     parser.add_argument("--momentum", type=float, default=0.9)
     parser.add_argument("--weight-decay", type=float, default=1e-4)
     parser.add_argument("--double-buffering", action="store_true")
+    parser.add_argument("--optimizer", default="sgd",
+                        choices=["sgd", "lars", "lamb"],
+                        help="lars/lamb are the large-batch scaling "
+                             "optimizers (layerwise adaptive LR) for pushing "
+                             "global batch past ~8k images")
+    parser.add_argument("--warmup-steps", type=int, default=0,
+                        help="linear LR warmup (large-batch recipe)")
     parser.add_argument("--allreduce-grad-dtype", default=None,
-                        choices=["bfloat16", "float16", "float32"],
+                        choices=["bfloat16", "float16", "float32", "int8"],
                         help="wire dtype for the cross-chip gradient mean "
-                             "(reference: pure_nccl allreduce_grad_dtype)")
+                             "(reference: pure_nccl allreduce_grad_dtype; "
+                             "int8 = quantized ring, beyond-reference)")
     parser.add_argument("--communicator", default="xla")
     args = parser.parse_args()
 
@@ -78,11 +86,21 @@ def main():
     variables = model.init(
         rng, jnp.zeros((1, args.image_size, args.image_size, 3)), train=False)
 
-    optimizer = mn.create_multi_node_optimizer(
-        optax.chain(
+    lr = args.lr
+    if args.warmup_steps:
+        lr = optax.linear_schedule(0.0, args.lr, args.warmup_steps)
+    if args.optimizer == "lars":
+        inner = optax.lars(lr, weight_decay=args.weight_decay,
+                           momentum=args.momentum)
+    elif args.optimizer == "lamb":
+        inner = optax.lamb(lr, weight_decay=args.weight_decay)
+    else:
+        inner = optax.chain(
             optax.add_decayed_weights(args.weight_decay),
-            optax.sgd(args.lr, momentum=args.momentum),
-        ),
+            optax.sgd(lr, momentum=args.momentum),
+        )
+    optimizer = mn.create_multi_node_optimizer(
+        inner,
         comm, double_buffering=args.double_buffering,
         allreduce_grad_dtype=args.allreduce_grad_dtype)
 

@@ -76,6 +76,16 @@ def main():
     else:
         assert g is None, "gather payload must be root-only"
 
+    # ---- shard_batch_local: per-process rows -> one global batch ----
+    local_rows = np.full((2, 3), float(rank), np.float32)
+    gb = mn.shard_batch_local({"x": local_rows}, comm.mesh)
+    assert gb["x"].shape == (2 * n, 3), gb["x"].shape
+    for s in gb["x"].addressable_shards:
+        np.testing.assert_array_equal(np.asarray(s.data), local_rows)
+    # global consistency: row-blocks are ordered by process
+    tot = float(jax.jit(lambda a: a.sum())(gb["x"]))
+    assert tot == 3 * 2 * sum(range(n)), tot
+
     # ---- multi-node iterator: all ranks see the MASTER stream ----
     from chainermn_tpu.iterators import (
         SerialIterator, create_multi_node_iterator,
