@@ -41,11 +41,22 @@ def _global_except_hook(exc_type, exc_value, tb) -> None:
         "aborting the whole job (reference analog: MPI_Abort):\n")
     sys.stderr.write("".join(traceback.format_exception(exc_type, exc_value, tb)))
     sys.stderr.flush()
-    try:
-        jax.distributed.shutdown()
-    except Exception:
-        pass
-    # Hard exit (not sys.exit): never return into a hung collective.
+    # Ask the coordinator to shut down, but NEVER let that block the abort:
+    # shutdown() itself can wait on peers that are wedged in the very
+    # collective this crash abandoned, which would turn the loud abort into
+    # the silent hang the hook exists to prevent.  Bounded side thread, then
+    # hard exit (not sys.exit) regardless.
+    import threading
+
+    def _shutdown():
+        try:
+            jax.distributed.shutdown()
+        except Exception:
+            pass
+
+    t = threading.Thread(target=_shutdown, daemon=True)
+    t.start()
+    t.join(timeout=5.0)
     os._exit(1)
 
 

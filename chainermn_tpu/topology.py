@@ -70,6 +70,12 @@ def init_distributed(
             process_id=process_id,
         )
         _initialized = True
+        # Crash hygiene (reference: auto-installed MPI_Abort hook): once a
+        # gang exists, an uncaught exception on one process must abort the
+        # whole job instead of wedging the others inside a collective.
+        from .global_except_hook import add_hook
+
+        add_hook()
     # No-op branch leaves the flag unset so a later *explicit* call (e.g. a
     # pod launcher passing coordinator_address) still initializes.
 

@@ -1,0 +1,87 @@
+"""Fault-injection worker — run by tests/test_chaos.py.
+
+Beyond-reference (SURVEY.md §5: the reference had "no fault injection
+harness"): one member of a jax.distributed gang raises mid-training and the
+test asserts the FULL failure story end-to-end:
+
+* the victim's uncaught exception hits the global except hook (installed by
+  ``init_distributed``) → rank-prefixed banner, coordinator shutdown,
+  hard exit 1 — the reference's ``MPI_Abort`` path;
+* the survivors, blocked in the next collective with nothing to raise, are
+  killed by the :class:`Watchdog` (exit 43) — the gap the reference left
+  open (a wedged rank hung its gang forever);
+* a fresh gang on the same checkpoint dir resumes from the newest
+  generation that is consistent across ALL ranks (the victim's last save),
+  finishes training, and reports success.
+
+Usage: python tests/_chaos_worker.py <n> <i> <port> <tmpdir> <crash|resume> \
+           <crash_at> <victim>
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+TOTAL_ITERS = 8
+
+
+def main():
+    n, i, port, tmpdir, phase = (int(sys.argv[1]), int(sys.argv[2]),
+                                 sys.argv[3], sys.argv[4], sys.argv[5])
+    crash_at, victim = int(sys.argv[6]), int(sys.argv[7])
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+
+    import chainermn_tpu as mn
+    from chainermn_tpu.extensions import (Watchdog,
+                                          create_multi_node_checkpointer)
+
+    # Product-surface bootstrap: installs the global except hook too.
+    mn.init_distributed(coordinator_address=f"localhost:{port}",
+                        num_processes=n, process_id=i)
+    assert sys.excepthook.__name__ == "_global_except_hook", sys.excepthook
+
+    comm = mn.create_communicator("xla")
+    rank = comm.rank
+
+    # Survivors have nothing to raise when a peer dies — the watchdog is
+    # what turns their silent hang into a loud bounded abort.
+    wd = Watchdog(timeout=8.0)
+    wd.initialize(None)
+
+    cp = create_multi_node_checkpointer(
+        name="chaos", comm=comm, path=tmpdir, keep=10, async_write=False)
+
+    state = {"rank": rank, "w": np.zeros(4, np.float32)}
+    start = 0
+    if phase == "resume":
+        loaded, it_resumed = cp.maybe_load(state)
+        assert it_resumed == crash_at - 1, (
+            f"expected newest gang-consistent generation {crash_at - 1}, "
+            f"got {it_resumed}")
+        state = loaded
+        np.testing.assert_array_equal(state["w"],
+                                      np.full(4, crash_at, np.float32))
+        start = it_resumed + 1
+        print(f"RESUMED {it_resumed}")
+
+    for it in range(start, TOTAL_ITERS):
+        if phase == "crash" and rank == victim and it == crash_at:
+            raise RuntimeError("injected chaos fault")
+        total = comm.allreduce_obj(it)  # lock-step gang collective
+        assert total == it * n, (total, it, n)
+        state["w"] = state["w"] + 1.0
+        cp.save(state, iteration=it)
+        wd.observe(None)
+
+    wd.finalize()
+    cp.finalize()
+    print(f"WORKER_OK {i}")
+
+
+if __name__ == "__main__":
+    main()

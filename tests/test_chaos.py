@@ -1,0 +1,93 @@
+"""Fault-injection (chaos) tests: crash one gang member mid-training.
+
+Beyond-reference (SURVEY.md §5: "no fault injection harness" upstream; its
+recovery story — except hook + checkpoint restart — was never tested under
+an actual mid-training failure).  Here: a 3-process jax.distributed gang
+trains with per-iteration checkpoints; process 1 raises at iteration 4.
+Phase 1 asserts loud bounded death for EVERY process (no silent hang);
+phase 2 asserts a fresh gang resumes from the newest gang-consistent
+generation and completes.
+
+See tests/_chaos_worker.py for the worker script.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+_WORKER = os.path.join(os.path.dirname(__file__), "_chaos_worker.py")
+N = 3
+# Passed to the worker on its command line (single source of truth here;
+# importing the worker module would break collection under bare `pytest`,
+# which does not put the repo root on sys.path).
+CRASH_AT = 4
+VICTIM = 1
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def _clean_env() -> dict:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = [f for f in env.get("XLA_FLAGS", "").split()
+             if "host_platform_device_count" not in f]
+    env["XLA_FLAGS"] = " ".join(flags)
+    return env
+
+def _run_gang(phase: str, tmpdir: str):
+    port = _free_port()
+    env = _clean_env()
+    procs = [
+        subprocess.Popen(
+            [sys.executable, _WORKER, str(N), str(i), str(port), tmpdir,
+             phase, str(CRASH_AT), str(VICTIM)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env)
+        for i in range(N)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise AssertionError(
+                f"{phase} gang did not terminate — the failure story has a "
+                f"silent hang:\n" + "\n".join(o or "" for o in outs))
+        outs.append(out)
+    return procs, outs
+
+
+def test_crash_then_resume(tmp_path):
+    tmpdir = str(tmp_path)
+
+    # ---- phase 1: inject the fault ----
+    procs, outs = _run_gang("crash", tmpdir)
+    assert procs[VICTIM].returncode == 1, outs[VICTIM][-2000:]
+    assert "aborting the whole job" in outs[VICTIM], outs[VICTIM][-2000:]
+    assert "injected chaos fault" in outs[VICTIM], outs[VICTIM][-2000:]
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        if i == VICTIM:
+            continue
+        # Survivors must die LOUDLY, never hang or report success.  Two
+        # legitimate paths: the victim's coordinator shutdown makes their
+        # blocked collective RAISE → except hook (rc 1); if the runtime
+        # stays silent instead, the watchdog kills them (rc 43).
+        assert p.returncode in (1, 43), (
+            f"survivor {i}: rc={p.returncode}\n{out[-2000:]}")
+        assert ("aborting the whole job" in out) or ("watchdog" in out), (
+            f"survivor {i} died without either abort path:\n{out[-2000:]}")
+        assert f"WORKER_OK {i}" not in out
+
+    # ---- phase 2: fresh gang resumes from the consistent generation ----
+    procs, outs = _run_gang("resume", tmpdir)
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"resume worker {i} failed:\n{out[-3000:]}"
+        assert f"RESUMED {CRASH_AT - 1}" in out, out[-2000:]
+        assert f"WORKER_OK {i}" in out, out[-2000:]
