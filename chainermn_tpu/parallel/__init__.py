@@ -30,6 +30,12 @@ from .pipeline import (  # noqa: F401
     pipeline_apply,
     stack_stage_params,
 )
+from .collective_matmul import (  # noqa: F401
+    all_gather_matmul,
+    make_all_gather_matmul,
+    make_matmul_reduce_scatter,
+    matmul_reduce_scatter,
+)
 from .hybrid import (  # noqa: F401
     init_fsdp_params,
     init_fsdp_state,
@@ -83,6 +89,10 @@ __all__ = [
     "init_tp_mlp_params",
     "tp_mlp_specs",
     "make_tensor_parallel_mlp",
+    "all_gather_matmul",
+    "matmul_reduce_scatter",
+    "make_all_gather_matmul",
+    "make_matmul_reduce_scatter",
     "make_hybrid_train_step",
     "make_hybrid_shard_map_step",
     "make_zero1_train_step",

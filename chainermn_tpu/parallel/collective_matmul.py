@@ -1,0 +1,131 @@
+"""Collective matmul: overlap TP collectives with the matmuls they feed.
+
+Beyond-reference perf primitives (the reference's only overlap was the
+double-buffered gradient allreduce): the scaling-book / Wang-et-al.
+"collective einsum" decompositions, built from ``ppermute`` + per-chunk
+matmuls so XLA:TPU can run each hop's ICI transfer concurrently with the
+current chunk's MXU work instead of serializing
+``all_gather → matmul`` / ``matmul → reduce_scatter``:
+
+* :func:`all_gather_matmul` — ``all_gather(x) @ w`` for row-sharded ``x``:
+  the ring rotates activation chunks; every step matmuls the chunk in hand
+  while the next one is in flight.  This is the Megatron-SP forward of a
+  column-parallel layer (sequence-sharded activations entering a
+  TP-sharded weight).
+* :func:`matmul_reduce_scatter` — ``reduce_scatter(x @ w)`` for
+  contraction-sharded ``x``/``w``: partial outputs are produced chunk by
+  chunk and folded into an accumulator that rides the ring; each step's
+  hop overlaps the next chunk's matmul.  The Megatron-SP backward-symmetric
+  projection of a row-parallel layer.
+
+Both are plain compositions of differentiable jax ops (no custom_vjp):
+autodiff of the unrolled ring yields the transposed ring automatically, and
+the unrolled Python loop (P is static) leaves XLA free to software-pipeline
+the hops.  Numerically each equals its unfused two-op form up to the usual
+reassociation tolerance; tests pin both forward and gradients against the
+unfused oracle.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def _shift(x, axis_name: str, offset: int = 1):
+    size = jax.lax.axis_size(axis_name)
+    perm = [(i, (i + offset) % size) for i in range(size)]
+    return jax.lax.ppermute(x, axis_name, perm=perm)
+
+
+def all_gather_matmul(x_local, w_local, *, axis_name: str):
+    """``all_gather(x, axis) @ w`` with ring/compute overlap.
+
+    Call INSIDE ``shard_map``.  ``x_local (S_loc, D)``: this rank's rows of
+    a leading-dim-sharded activation; ``w_local (D, F_loc)``: any weight
+    resident on this rank (typically the column-parallel shard).  Returns
+    ``(P*S_loc, F_loc)`` — the full gathered rows times the local weight,
+    bitwise-independent of P only up to matmul reassociation.
+    """
+    p = jax.lax.axis_size(axis_name)
+    if p == 1:
+        return x_local @ w_local
+    idx = jax.lax.axis_index(axis_name)
+    s_loc = x_local.shape[0]
+    out = jnp.zeros((p, s_loc, w_local.shape[1]),
+                    jnp.promote_types(x_local.dtype, w_local.dtype))
+    chunk = x_local
+    for k in range(p):
+        if k + 1 < p:
+            # Launch the hop FIRST: the transfer of the next chunk has no
+            # dependence on this step's matmul, so XLA may overlap them.
+            nxt = _shift(chunk, axis_name)
+        # The chunk in hand originated at rank (idx - k): deposit its rows
+        # at that global position.
+        row = jnp.mod(idx - k, p)
+        out = jax.lax.dynamic_update_index_in_dim(
+            out, (chunk @ w_local).astype(out.dtype), row, axis=0)
+        if k + 1 < p:
+            chunk = nxt
+    return out.reshape(p * s_loc, w_local.shape[1])
+
+
+def matmul_reduce_scatter(x_local, w_local, *, axis_name: str):
+    """``reduce_scatter(x @ w, axis)`` with ring/compute overlap.
+
+    Call INSIDE ``shard_map``.  ``x_local (S, D_loc)`` and ``w_local
+    (D_loc, F)`` hold this rank's shard of the CONTRACTION dimension; the
+    full product would need a psum.  Instead the output rows are reduced
+    chunkwise around the ring: returns ``(S/P, F)`` — this rank's rows of
+    the summed product (``jax.lax.psum_scatter`` semantics, tiled).
+    """
+    p = jax.lax.axis_size(axis_name)
+    if p == 1:
+        return x_local @ w_local
+    idx = jax.lax.axis_index(axis_name)
+    s = x_local.shape[0]
+    if s % p:
+        raise ValueError(f"leading dim {s} not divisible by axis size {p}")
+    s_loc = s // p
+    out_dtype = jnp.promote_types(x_local.dtype, w_local.dtype)
+    # Accumulate in at least fp32 (bf16 inputs must not sum in bf16), but
+    # never BELOW the promoted input precision (f64 stays f64).
+    acc_dtype = jnp.promote_types(jnp.float32, out_dtype)
+    acc = jnp.zeros((s_loc, w_local.shape[1]), acc_dtype)
+    for k in range(p):
+        if k > 0:
+            # The accumulator for chunk j travels j+1 → j+2 → … → j; each
+            # hop is independent of the chunk matmul that follows it.
+            acc = _shift(acc, axis_name)
+        j = jnp.mod(idx - 1 - k, p)
+        rows = jax.lax.dynamic_slice_in_dim(x_local, j * s_loc, s_loc, axis=0)
+        acc = acc + (rows @ w_local).astype(acc_dtype)
+    return acc.astype(out_dtype)
+
+
+def make_all_gather_matmul(mesh: Optional[Mesh] = None,
+                           axis_name: Optional[str] = None):
+    """Eager/jit face: ``fn(x, w) -> y`` over globals; ``x`` row-sharded,
+    ``w`` column-sharded, ``y`` column-sharded (rows full)."""
+    from ._factory import make_global_apply, resolve_mesh_axis
+
+    mesh, ax = resolve_mesh_axis(mesh, axis_name)
+    return make_global_apply(
+        partial(all_gather_matmul, axis_name=ax),
+        mesh, (P(ax), P(None, ax)), P(None, ax))
+
+
+def make_matmul_reduce_scatter(mesh: Optional[Mesh] = None,
+                               axis_name: Optional[str] = None):
+    """Eager/jit face: ``fn(x, w) -> y`` over globals; ``x`` sharded on its
+    second (contraction) dim, ``w`` on its first, ``y`` row-sharded."""
+    from ._factory import make_global_apply, resolve_mesh_axis
+
+    mesh, ax = resolve_mesh_axis(mesh, axis_name)
+    return make_global_apply(
+        partial(matmul_reduce_scatter, axis_name=ax),
+        mesh, (P(None, ax), P(ax)), P(ax))
