@@ -128,25 +128,99 @@ def compile_with_flops(step, variables, opt_state, batch):
 
 
 def measure(step, variables, opt_state, batch, steps):
-    """Two timing epochs, report the slower.
+    """Two timing epochs, report the slower; timing ends at a HOST READBACK.
 
-    Empirically (probed on the axon TPU tunnel) the FIRST timed loop in a
-    process can return ~40x faster than physics allows — block_until_ready
-    returning before the work is done.  A second epoch measures steady
-    state; taking the max dt makes a too-good-to-be-true artifact
-    impossible to report.
+    Empirically (probed on the axon TPU tunnel) ``block_until_ready`` can
+    return long before the work is done — even on the full output tree —
+    inflating throughput by 100x+.  ``float(loss)`` cannot lie: the scalar
+    must physically exist on the host, and each step's params feed the
+    next, so the final loss transitively depends on every timed step.
+    Two epochs + max(dt) additionally guard against first-loop artifacts.
     """
     for _ in range(2):  # compile + warmup
-        variables, opt_state, loss, _ = step(variables, opt_state, batch)
-    loss.block_until_ready()
-    dt = 0.0
+        variables, opt_state, loss, *_ = step(variables, opt_state, batch)
+    float(loss)
+    dt, out = 0.0, 0.0
     for _ in range(2):
         t0 = time.perf_counter()
         for _ in range(steps):
-            variables, opt_state, loss, _ = step(variables, opt_state, batch)
-        loss.block_until_ready()
+            variables, opt_state, loss, *_ = step(variables, opt_state, batch)
+        out = float(loss)  # host readback = the timing barrier
         dt = max(dt, time.perf_counter() - t0)
-    return dt, float(loss)
+    return dt, out
+
+
+def bench_transformer_lm(n_chips_hint=None):
+    """Tokens/sec/chip + MFU for a TP transformer LM with flash attention.
+
+    The FLOPs-dense half of the perf story: ResNet-50's conv shapes cap its
+    MFU well below what the MXU sustains on big matmuls; a decoder LM shows
+    the framework's ceiling.  Runs DP×TP over a (n_chips, 1) mesh via the
+    same make_hybrid_shard_map_step users call.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    import chainermn_tpu as mn
+    from chainermn_tpu.parallel import (
+        init_tp_transformer_lm, make_hybrid_shard_map_step, shard_pytree,
+        state_specs_like, tp_transformer_lm_loss, transformer_lm_specs)
+    from functools import partial
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    vocab, d_model, n_heads, n_layers, seq = 32768, 1024, 16, 8, 1024
+    n_chips = len(jax.devices())
+    per_chip_batch = 8
+    mesh = mn.make_nd_mesh(("data", "model"), (n_chips, 1))
+    params = init_tp_transformer_lm(
+        jax.random.PRNGKey(0), vocab, d_model, n_heads, n_layers,
+        max_len=seq, dtype=jnp.bfloat16)
+    specs = transformer_lm_specs(params, "model")
+    loss_fn = partial(tp_transformer_lm_loss, head_dim=d_model // n_heads,
+                      axis_name="model", attn_impl="flash")
+    optimizer = optax.sgd(1e-2)
+    step = make_hybrid_shard_map_step(
+        loss_fn, optimizer, mesh, params, specs, data_axis="data",
+        batch_spec=P("data"))
+    p = shard_pytree(params, mesh, specs)
+    st = shard_pytree(optimizer.init(params), mesh,
+                      state_specs_like(optimizer, params, specs))
+    tokens = np.random.RandomState(0).randint(
+        0, vocab, (per_chip_batch * n_chips, seq + 1)).astype(np.int32)
+    batch = (jax.device_put(tokens, NamedSharding(mesh, P("data"))),)
+
+    step_c, flops_per_step = compile_with_flops(step, p, st, batch)
+    dt, _ = measure(step_c, p, st, batch, steps=10)
+    toks = per_chip_batch * seq  # per chip per step
+    tps = 10 * toks / dt  # measure() already covers all chips' shards: dt is
+    # wall-clock for the whole mesh, so per-chip tokens/sec uses per-chip toks
+    n_params = sum(np.prod(l.shape) for l in jax.tree_util.tree_leaves(params))
+    flops_source = "compiled"
+    # Per-chip convention throughout, same as the ResNet path: GSPMD
+    # compiles one per-device program, so cost_analysis FLOPs are per-chip.
+    if not flops_per_step:
+        # 6·N per token (fwd+bwd matmuls) + 12·L·D·S per token (attention)
+        flops_per_step = (6.0 * n_params
+                          + 12.0 * n_layers * d_model * seq) * toks
+        flops_source = "analytic"
+    dev = jax.devices()[0]
+    peak = peak_flops_for(dev.device_kind)
+    mfu = flops_per_step * 10 / dt / peak if peak else None
+    suspect = bool(mfu and mfu > 1.0)
+    if suspect:
+        print(f"bench: WARNING transformer MFU {mfu:.2f} > 1.0 impossible — "
+              f"number not credible", file=sys.stderr)
+    return {
+        "tokens_per_sec_per_chip": round(tps, 1),
+        "mfu": round(mfu, 4) if mfu else None,
+        "suspect": suspect,
+        "flops_source": flops_source,
+        "n_params": int(n_params),
+        "config": f"d{d_model} L{n_layers} h{n_heads} S{seq} V{vocab} "
+                  f"b{per_chip_batch}/chip bf16 flash",
+    }
 
 
 def scaling_worker(n):
@@ -272,7 +346,7 @@ def main():
     # --- per-chip batch sweep on the real chip -----------------------------
     batch_sweep = {}
     if on_tpu:
-        for b in (32, 64, 128, 256):
+        for b in (32, 64, 128, 256, 512):
             if b == per_chip_batch:
                 batch_sweep[str(b)] = {"ips": round(ips_per_chip, 2),
                                        "mfu": mfu_of(ips_per_chip)}
@@ -307,6 +381,18 @@ def main():
                   file=sys.stderr)
     suspect = flops_suspect or mfu_suspect
 
+    # --- transformer LM: the FLOPs-dense half of the perf story ------------
+    transformer = None
+    if on_tpu:
+        try:
+            transformer = bench_transformer_lm()
+            # The headline suspect flag covers EVERY reported number: a
+            # physically impossible transformer MFU must not hide behind a
+            # credible ResNet one.
+            suspect = suspect or bool(transformer.get("suspect"))
+        except Exception as e:
+            print(f"bench: transformer section failed: {e!r}", file=sys.stderr)
+
     # --- DP weak-scaling sweep (virtual CPU mesh, fresh subprocesses) ------
     scaling = None if args.skip_scaling else run_scaling_sweep()
 
@@ -323,6 +409,7 @@ def main():
         "flops_source": flops_source if flops_per_image else None,
         "allreduce_grad_dtype": args.allreduce_grad_dtype,
         "batch_sweep": batch_sweep,
+        "transformer_lm": transformer,
         "scaling": scaling,
     }))
 

@@ -136,7 +136,11 @@ def _inherit_vma(*xs) -> frozenset:
     return frozenset(vma)
 
 
-def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret, seq_len):
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret, seq_len,
+               group: int = 1):
+    """``q (B·H, S, D)``, ``k/v (B·H/group, S, D)``: ``group`` consecutive
+    q heads share one KV head (GQA/MQA).  The sharing happens in the
+    BlockSpec index_map — KV is never materialized at H heads."""
     bh, s, d = q.shape
     bq = _pick_block(s, block_q)
     bk = _pick_block(s, block_k)
@@ -152,8 +156,8 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret, seq_len):
         grid=(bh, nq, nk),
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b // group, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b // group, j, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
@@ -236,54 +240,90 @@ def _bwd_blockwise(q, k, v, out, lse, do, causal, scale, block_k, seq_len,
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash_bhsd(q, k, v, causal, block_q, block_k, interpret, seq_len):
+def _expand_kv(x, group):
+    """(B·Hkv, S, D) → (B·H, S, D) by repeating each KV head ``group``
+    times (backward-only; the forward shares via the index_map)."""
+    if group == 1:
+        return x
+    return jnp.repeat(x, group, axis=0)
+
+
+def _fold_dkv(dx, group):
+    """(B·H, S, D) grads → (B·Hkv, S, D): sum the shared-head group in fp32
+    (an MQA group can be 32+ heads; a bf16 tree-sum would shed low-order
+    gradient mass) — callers cast back to the KV dtype."""
+    if group == 1:
+        return dx
+    bh, s, d = dx.shape
+    return dx.reshape(bh // group, group, s, d).astype(jnp.float32).sum(1)
+
+
+def _bwd_gqa(q, k, v, out, lse, do, causal, scale, block_k, seq_len, group,
+             dlse=None):
+    """GQA backward: recompute with KV expanded to the full q-head count,
+    then fold the shared-head gradient groups back down.  The expansion is
+    backward-only and O(S·D·H) — dominated by the (BH, S, block) score
+    recompute the blockwise backward already carries."""
+    dq, dk, dv = _bwd_blockwise(
+        q, _expand_kv(k, group), _expand_kv(v, group), out, lse, do,
+        causal, scale, block_k, seq_len, dlse=dlse)
+    return dq, _fold_dkv(dk, group).astype(k.dtype), \
+        _fold_dkv(dv, group).astype(v.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash_bhsd(q, k, v, causal, block_q, block_k, interpret, seq_len, group):
     scale = 1.0 / (q.shape[-1] ** 0.5)
     out, _ = _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
-                        seq_len)
+                        seq_len, group)
     return out
 
 
-def _flash_bhsd_fwd(q, k, v, causal, block_q, block_k, interpret, seq_len):
+def _flash_bhsd_fwd(q, k, v, causal, block_q, block_k, interpret, seq_len,
+                    group):
     scale = 1.0 / (q.shape[-1] ** 0.5)
     out, lse = _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
-                          seq_len)
+                          seq_len, group)
     return out, (q, k, v, out, lse)
 
 
-def _flash_bhsd_bwd(causal, block_q, block_k, interpret, seq_len, res, do):
+def _flash_bhsd_bwd(causal, block_q, block_k, interpret, seq_len, group, res,
+                    do):
     q, k, v, out, lse = res
     scale = 1.0 / (q.shape[-1] ** 0.5)
-    return _bwd_blockwise(q, k, v, out, lse, do, causal, scale, block_k,
-                          seq_len)
+    return _bwd_gqa(q, k, v, out, lse, do, causal, scale, block_k,
+                    seq_len, group)
 
 
 _flash_bhsd.defvjp(_flash_bhsd_fwd, _flash_bhsd_bwd)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash_bhsd_lse(q, k, v, causal, block_q, block_k, interpret, seq_len):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash_bhsd_lse(q, k, v, causal, block_q, block_k, interpret, seq_len,
+                    group):
     """Like :func:`_flash_bhsd` but also returns the LSE as a DIFFERENTIABLE
     output — ring attention merges visiting blocks with LSE-derived weights,
     so gradients must flow through it."""
     scale = 1.0 / (q.shape[-1] ** 0.5)
     return _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
-                      seq_len)
+                      seq_len, group)
 
 
-def _flash_bhsd_lse_fwd(q, k, v, causal, block_q, block_k, interpret, seq_len):
+def _flash_bhsd_lse_fwd(q, k, v, causal, block_q, block_k, interpret, seq_len,
+                        group):
     scale = 1.0 / (q.shape[-1] ** 0.5)
     out, lse = _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
-                          seq_len)
+                          seq_len, group)
     return (out, lse), (q, k, v, out, lse)
 
 
-def _flash_bhsd_lse_bwd(causal, block_q, block_k, interpret, seq_len, res, cts):
+def _flash_bhsd_lse_bwd(causal, block_q, block_k, interpret, seq_len, group,
+                        res, cts):
     q, k, v, out, lse = res
     do, dlse = cts
     scale = 1.0 / (q.shape[-1] ** 0.5)
-    return _bwd_blockwise(q, k, v, out, lse, do, causal, scale, block_k,
-                          seq_len, dlse=dlse)
+    return _bwd_gqa(q, k, v, out, lse, do, causal, scale, block_k,
+                    seq_len, group, dlse=dlse)
 
 
 _flash_bhsd_lse.defvjp(_flash_bhsd_lse_fwd, _flash_bhsd_lse_bwd)
@@ -305,10 +345,22 @@ def flash_attention(q, k, v, causal: bool = False, block_q: int = 128,
     ``return_lse=True`` additionally returns the per-query log-sum-exp
     ``(B, H, S)`` as a differentiable output (the block-merge currency of
     ring attention).
+
+    GQA/MQA: ``k``/``v`` may carry FEWER heads than ``q`` (``H_kv`` with
+    ``H % H_kv == 0``); each group of ``H/H_kv`` consecutive q heads
+    attends the shared KV head.  The sharing is done in the kernel's block
+    index map — KV never materializes at ``H`` heads in the forward.
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     b, s, h, d = q.shape
+    h_kv = k.shape[2]
+    if h % h_kv:
+        raise ValueError(
+            f"q heads {h} not a multiple of kv heads {h_kv} (GQA contract)")
+    if v.shape[2] != h_kv:
+        raise ValueError(f"k has {h_kv} heads but v has {v.shape[2]}")
+    group = h // h_kv
     s_pad = s
     if min(_pick_block(s, block_q), _pick_block(s, block_k)) < _MIN_BLOCK:
         lcm = block_q * block_k // math.gcd(block_q, block_k)
@@ -317,13 +369,15 @@ def flash_attention(q, k, v, causal: bool = False, block_q: int = 128,
         q, k, v = (jnp.pad(x, pad) for x in (q, k, v))
 
     def to_bhsd(x):
-        return x.transpose(0, 2, 1, 3).reshape(b * h, s_pad, x.shape[-1])
+        nh = x.shape[2]
+        return x.transpose(0, 2, 1, 3).reshape(b * nh, s_pad, x.shape[-1])
 
     if return_lse:
         out, lse = _flash_bhsd_lse(to_bhsd(q), to_bhsd(k), to_bhsd(v),
-                                   causal, block_q, block_k, interpret, s)
+                                   causal, block_q, block_k, interpret, s,
+                                   group)
         return (out.reshape(b, h, s_pad, d)[:, :, :s].transpose(0, 2, 1, 3),
                 lse.reshape(b, h, s_pad)[:, :, :s])
     out = _flash_bhsd(to_bhsd(q), to_bhsd(k), to_bhsd(v),
-                      causal, block_q, block_k, interpret, s)
+                      causal, block_q, block_k, interpret, s, group)
     return out.reshape(b, h, s_pad, d)[:, :, :s].transpose(0, 2, 1, 3)

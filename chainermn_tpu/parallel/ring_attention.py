@@ -121,9 +121,21 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     configuration.
     """
     if attn_impl == "flash":
+        # GQA (fewer KV heads than Q heads) passes straight through: the
+        # flash kernel shares KV heads in its block index map.
         return _ring_flash(q, k, v, axis_name, causal)
     if attn_impl != "xla":
         raise ValueError(f"attn_impl must be 'xla' or 'flash', got {attn_impl!r}")
+    if k.shape[2] != q.shape[2]:
+        # GQA on the materializing path: expand KV to the q head count (the
+        # O(S²) scores already dominate memory here; the flash path is the
+        # one that keeps KV unexpanded).
+        if q.shape[2] % k.shape[2]:
+            raise ValueError(
+                f"q heads {q.shape[2]} not a multiple of kv heads {k.shape[2]}")
+        g = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, g, axis=2)
+        v = jnp.repeat(v, g, axis=2)
     p_size = jax.lax.psum(1, axis_name)
     my = jax.lax.axis_index(axis_name)
     b, s_q, h, d = q.shape

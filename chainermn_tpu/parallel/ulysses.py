@@ -33,7 +33,12 @@ def _full_attention(q, k, v, causal: bool):
     """Plain softmax attention; (B, S, h, D) layout.  Scores and the PV
     product accumulate in fp32 (``preferred_element_type``) while the
     matmul operands keep their input dtype — bf16 MXU rate, fp32 sums —
-    matching ring_attention's numerics."""
+    matching ring_attention's numerics.  GQA inputs (fewer KV heads) are
+    expanded here; the flash path shares them without expansion."""
+    if k.shape[2] != q.shape[2]:
+        g = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, g, axis=2)
+        v = jnp.repeat(v, g, axis=2)
     d = q.shape[-1]
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                    preferred_element_type=jnp.float32) / (d ** 0.5)
@@ -60,10 +65,16 @@ def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     """
     p_size = jax.lax.psum(1, axis_name)
     b, s_local, h, d = q.shape
+    h_kv = k.shape[2]
     if h % p_size != 0:
         raise ValueError(
             f"Ulysses needs heads ({h}) divisible by axis size ({p_size}); "
             "use ring_attention for small head counts")
+    if h % h_kv or h_kv % p_size:
+        raise ValueError(
+            f"GQA under Ulysses needs q heads ({h}) a multiple of kv heads "
+            f"({h_kv}) and kv heads divisible by the axis size ({p_size}); "
+            "use ring_attention otherwise")
 
     def seq_to_heads(x):
         # (B, S_local, H, D) → (B, S_global, H/P, D): hand each device the

@@ -134,3 +134,63 @@ class TestUlyssesFlash:
         q, k, v = qkv8()
         with pytest.raises(ValueError, match="attn_impl"):
             make_ulysses_attention(mesh=mesh, attn_impl="nope")(q, k, v)
+
+
+class TestGQA:
+    """Grouped-query attention: fewer KV heads than Q heads, shared via the
+    kernel's block index map (forward) / repeat+fold (backward)."""
+
+    def _reference_gqa(self, q, k, v, causal=False):
+        group = q.shape[2] // k.shape[2]
+        kf = jnp.repeat(k, group, axis=2)
+        vf = jnp.repeat(v, group, axis=2)
+        return reference(q, jnp.asarray(kf), jnp.asarray(vf), causal)
+
+    @pytest.mark.parametrize("h_kv", [1, 2])  # MQA and 2-group GQA
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_forward_matches_reference(self, h_kv, causal):
+        rng = np.random.RandomState(0)
+        q = rng.randn(B, S, H, D).astype(np.float32)
+        k = rng.randn(B, S, h_kv, D).astype(np.float32)
+        v = rng.randn(B, S, h_kv, D).astype(np.float32)
+        got = flash_attention(q, k, v, causal=causal, block_q=32, block_k=32)
+        want = self._reference_gqa(q, k, v, causal)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_gradients_match_reference(self):
+        rng = np.random.RandomState(1)
+        q = rng.randn(B, S, H, D).astype(np.float32)
+        k = rng.randn(B, S, 2, D).astype(np.float32)
+        v = rng.randn(B, S, 2, D).astype(np.float32)
+
+        def loss_flash(q, k, v):
+            return (flash_attention(q, k, v, causal=True,
+                                    block_q=32, block_k=32) ** 2).sum()
+
+        def loss_ref(q, k, v):
+            return (self._reference_gqa(q, k, v, causal=True) ** 2).sum()
+
+        got = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        want = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for g, w, name in zip(got, want, "qkv"):
+            assert g.shape == w.shape, name  # dk/dv folded back to h_kv heads
+            np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                       rtol=2e-3, atol=2e-4,
+                                       err_msg=f"grad wrt {name}")
+
+    def test_lse_path_with_gqa(self):
+        rng = np.random.RandomState(2)
+        q = rng.randn(B, S, H, D).astype(np.float32)
+        k = rng.randn(B, S, 1, D).astype(np.float32)
+        v = rng.randn(B, S, 1, D).astype(np.float32)
+        out, lse = flash_attention(q, k, v, return_lse=True)
+        assert lse.shape == (B, H, S)  # LSE per Q head, not per KV head
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.asarray(self._reference_gqa(q, k, v)),
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_bad_head_ratio_raises(self):
+        q, k, v = qkv()
+        with pytest.raises(ValueError, match="multiple"):
+            flash_attention(q, k[:, :, :3], v[:, :, :3])
