@@ -54,17 +54,40 @@ def tp_attention(x, params, *, head_dim: int, axis_name: str,
     row-parallel output projection) per call.
     """
     b, s, d = x.shape
-    h_local = params["bqkv"].shape[0] // (3 * head_dim)
 
-    qkv = column_parallel_dense(x, params["wqkv"], params["bqkv"],
-                                axis_name=axis_name)        # (B, S, 3·Dl)
-    qkv = qkv.reshape(b, s, h_local, 3, head_dim)
-    q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]  # (B, S, hl, hd)
+    if "wq" in params:
+        # GQA layout: separate q and fused kv projections, both
+        # column-parallel (q heads and kv heads each sharded over the model
+        # axis; spec requires n_kv_heads % P == 0 so groups stay aligned).
+        q = column_parallel_dense(x, params["wq"], params["bq"],
+                                  axis_name=axis_name)
+        h_local = q.shape[-1] // head_dim
+        q = q.reshape(b, s, h_local, head_dim)
+        kv = column_parallel_dense(x, params["wkv"], params["bkv"],
+                                   axis_name=axis_name)
+        if kv.shape[-1] % (2 * head_dim):
+            raise ValueError(
+                f"local wkv shard width {kv.shape[-1]} is not a whole number "
+                f"of KV heads (2*head_dim={2 * head_dim}) — n_kv_heads must "
+                f"be divisible by the model-axis size")
+        hkv_local = kv.shape[-1] // (2 * head_dim)
+        kv = kv.reshape(b, s, hkv_local, 2, head_dim)
+        k, v = kv[..., 0, :], kv[..., 1, :]
+    else:
+        h_local = params["bqkv"].shape[0] // (3 * head_dim)
+        qkv = column_parallel_dense(x, params["wqkv"], params["bqkv"],
+                                    axis_name=axis_name)    # (B, S, 3·Dl)
+        qkv = qkv.reshape(b, s, h_local, 3, head_dim)
+        q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
 
     if attn_impl == "flash":
         from ..ops.flash_attention import flash_attention
         ctx = flash_attention(q, k, v, causal=causal)
     else:
+        if k.shape[2] != h_local:  # GQA on the materializing path
+            g = h_local // k.shape[2]
+            k = jnp.repeat(k, g, axis=2)
+            v = jnp.repeat(v, g, axis=2)
         scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                             preferred_element_type=jnp.float32)
         scores = scores / (head_dim ** 0.5)
@@ -164,10 +187,19 @@ def sp_block(x, params, *, head_dim: int, axis_name: str, causal: bool = True,
     n_heads = d // head_dim
     a = params["attn"]
     h = _layer_norm(x, params["ln1_scale"], params["ln1_bias"])
-    qkv = jnp.matmul(h, a["wqkv"],
-                     preferred_element_type=jnp.float32).astype(x.dtype)
-    qkv = (qkv + a["bqkv"]).reshape(b, s_local, n_heads, 3, head_dim)
-    q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
+    if "wq" in a:  # GQA: fewer KV heads ride the ring / all-to-all
+        q = (jnp.matmul(h, a["wq"], preferred_element_type=jnp.float32)
+             .astype(x.dtype) + a["bq"]).reshape(b, s_local, n_heads, head_dim)
+        kv = (jnp.matmul(h, a["wkv"], preferred_element_type=jnp.float32)
+              .astype(x.dtype) + a["bkv"])
+        n_kv = kv.shape[-1] // (2 * head_dim)
+        kv = kv.reshape(b, s_local, n_kv, 2, head_dim)
+        k, v = kv[..., 0, :], kv[..., 1, :]
+    else:
+        qkv = jnp.matmul(h, a["wqkv"],
+                         preferred_element_type=jnp.float32).astype(x.dtype)
+        qkv = (qkv + a["bqkv"]).reshape(b, s_local, n_heads, 3, head_dim)
+        q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
     if sp_impl == "ring":
         ctx = ring_attention(q, k, v, axis_name=axis_name, causal=causal,
                              attn_impl=attn_impl)
@@ -233,10 +265,22 @@ def sp_transformer_lm_loss(params, batch, *, head_dim: int, axis_name: str,
 
 def init_tp_transformer_lm(rng, vocab: int, d_model: int, n_heads: int,
                            n_layers: int, d_hidden: Optional[int] = None,
-                           max_len: int = 512, dtype=jnp.float32) -> Dict[str, Any]:
-    """GLOBAL (unsharded) parameter pytree for the TP transformer LM."""
+                           max_len: int = 512, dtype=jnp.float32,
+                           n_kv_heads: Optional[int] = None) -> Dict[str, Any]:
+    """GLOBAL (unsharded) parameter pytree for the TP transformer LM.
+
+    ``n_kv_heads`` (GQA/MQA): when set below ``n_heads``, attention carries
+    separate ``wq`` and fused ``wkv`` projections (both head-major) instead
+    of the fused ``wqkv``; the KV cache and projection shrink by
+    ``n_heads / n_kv_heads``.  Under TP, ``n_kv_heads`` must stay divisible
+    by the model-axis size.
+    """
     if d_model % n_heads:
         raise ValueError(f"d_model {d_model} not divisible by n_heads {n_heads}")
+    if n_kv_heads is not None and n_heads % n_kv_heads:
+        raise ValueError(
+            f"n_heads {n_heads} not a multiple of n_kv_heads {n_kv_heads}")
+    gqa = n_kv_heads is not None and n_kv_heads != n_heads
     d_hidden = d_hidden or 4 * d_model
     head_dim = d_model // n_heads
     keys = jax.random.split(rng, 2 + 4 * n_layers)
@@ -248,22 +292,39 @@ def init_tp_transformer_lm(rng, vocab: int, d_model: int, n_heads: int,
     blocks = []
     for i in range(n_layers):
         k1, k2, k3, k4 = keys[2 + 4 * i: 6 + 4 * i]
-        # Head-major qkv layout: columns are [head0: q|k|v, head1: q|k|v, …]
-        # so a contiguous column shard over the model axis is whole heads.
-        wq, wk, wv = (dense(kk, d_model, d_model).reshape(
-            d_model, n_heads, head_dim) for kk in jax.random.split(k1, 3))
-        wqkv = jnp.stack([wq, wk, wv], axis=2).reshape(d_model, 3 * d_model)
+        if gqa:
+            kq, kk, kv_ = jax.random.split(k1, 3)
+            d_kv = n_kv_heads * head_dim
+            # kv-head-major: columns are [head0: k|v, head1: k|v, …] so a
+            # contiguous column shard over the model axis is whole KV heads.
+            wk = dense(kk, d_model, d_kv).reshape(d_model, n_kv_heads, head_dim)
+            wv = dense(kv_, d_model, d_kv).reshape(d_model, n_kv_heads, head_dim)
+            attn = {
+                "wq": dense(kq, d_model, d_model),
+                "bq": jnp.zeros((d_model,), dtype),
+                "wkv": jnp.stack([wk, wv], axis=2).reshape(d_model, 2 * d_kv),
+                "bkv": jnp.zeros((2 * d_kv,), dtype),
+                "wo": dense(k2, d_model, d_model),
+                "bo": jnp.zeros((d_model,), dtype),
+            }
+        else:
+            # Head-major qkv layout: columns are [head0: q|k|v, head1:
+            # q|k|v, …] so a contiguous column shard is whole heads.
+            wq, wk, wv = (dense(kk, d_model, d_model).reshape(
+                d_model, n_heads, head_dim) for kk in jax.random.split(k1, 3))
+            attn = {
+                "wqkv": jnp.stack([wq, wk, wv], axis=2).reshape(
+                    d_model, 3 * d_model),
+                "bqkv": jnp.zeros((3 * d_model,), dtype),
+                "wo": dense(k2, d_model, d_model),
+                "bo": jnp.zeros((d_model,), dtype),
+            }
         blocks.append({
             "ln1_scale": jnp.ones((d_model,), dtype),
             "ln1_bias": jnp.zeros((d_model,), dtype),
             "ln2_scale": jnp.ones((d_model,), dtype),
             "ln2_bias": jnp.zeros((d_model,), dtype),
-            "attn": {
-                "wqkv": wqkv,
-                "bqkv": jnp.zeros((3 * d_model,), dtype),
-                "wo": dense(k2, d_model, d_model),
-                "bo": jnp.zeros((d_model,), dtype),
-            },
+            "attn": attn,
             "mlp": {
                 "wi": dense(k3, d_model, d_hidden),
                 "bi": jnp.zeros((d_hidden,), dtype),
@@ -293,11 +354,17 @@ def transformer_lm_specs(params, axis_name: str = "model"):
     ax = axis_name
 
     def block_specs(blk):
+        if "wq" in blk["attn"]:  # GQA: separate q / fused kv projections
+            attn = {"wq": P(None, ax), "bq": P(ax),
+                    "wkv": P(None, ax), "bkv": P(ax),
+                    "wo": P(ax, None), "bo": P()}
+        else:
+            attn = {"wqkv": P(None, ax), "bqkv": P(ax),
+                    "wo": P(ax, None), "bo": P()}
         return {
             "ln1_scale": P(), "ln1_bias": P(),
             "ln2_scale": P(), "ln2_bias": P(),
-            "attn": {"wqkv": P(None, ax), "bqkv": P(ax),
-                     "wo": P(ax, None), "bo": P()},
+            "attn": attn,
             "mlp": {"wi": P(None, ax), "bi": P(ax),
                     "wo": P(ax, None), "bo": P()},
         }

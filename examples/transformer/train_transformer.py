@@ -29,6 +29,9 @@ def main():
     parser.add_argument("--vocab", type=int, default=256)
     parser.add_argument("--d-model", type=int, default=64)
     parser.add_argument("--n-heads", type=int, default=4)
+    parser.add_argument("--kv-heads", type=int, default=None,
+                        help="GQA: fewer KV heads than Q heads (must stay "
+                             "divisible by --tp)")
     parser.add_argument("--n-layers", type=int, default=2)
     parser.add_argument("--seq-len", type=int, default=32)
     parser.add_argument("--batchsize", type=int, default=32, help="global batch")
@@ -65,7 +68,7 @@ def main():
 
     params = init_tp_transformer_lm(
         jax.random.PRNGKey(0), args.vocab, args.d_model, args.n_heads,
-        args.n_layers, max_len=args.seq_len)
+        args.n_layers, max_len=args.seq_len, n_kv_heads=args.kv_heads)
     specs = transformer_lm_specs(params, "model")
     optimizer = optax.adam(args.lr)
     loss_fn = partial(tp_transformer_lm_loss,

@@ -159,3 +159,62 @@ class TestTraining:
             p, st, loss = step(p, st, b)
             losses.append(float(loss))
         assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+
+class TestGQATransformer:
+    """GQA (n_kv_heads < n_heads) through the TP and SP transformer LMs:
+    TP-sharded loss/grads must equal the unsharded oracle, and SP blocks
+    must route the smaller KV head count through the ring."""
+
+    def _gqa_params_and_batch(self, seed=0):
+        params = init_tp_transformer_lm(
+            jax.random.PRNGKey(seed), VOCAB, D, HEADS, LAYERS, max_len=SEQ,
+            n_kv_heads=2)
+        rng = np.random.RandomState(seed)
+        tokens = rng.randint(0, VOCAB, (BATCH, SEQ + 1)).astype(np.int32)
+        return params, (tokens,)
+
+    def test_params_shrink(self):
+        params, _ = self._gqa_params_and_batch()
+        attn = params["blocks"][0]["attn"]
+        assert "wq" in attn and "wkv" in attn and "wqkv" not in attn
+        assert attn["wkv"].shape == (D, 2 * 2 * HEAD_DIM)  # 2 kv heads
+
+    @pytest.mark.parametrize("attn_impl", ["xla", "flash"])
+    def test_tp2_matches_tp1(self, devices, attn_impl):
+        params, batch = self._gqa_params_and_batch()
+        mesh1 = mn.make_nd_mesh(("data", "model"), (4, 1), devices[:4])
+        mesh2 = mn.make_nd_mesh(("data", "model"), (4, 2), devices)
+        l1, g1 = run_loss(mesh1, (4, 1), params, batch, attn_impl)
+        l2, g2 = run_loss(mesh2, (4, 2), params, batch, attn_impl)
+        np.testing.assert_allclose(l1, l2, rtol=1e-5)
+        for a, b in zip(jax.tree_util.tree_leaves(g1),
+                        jax.tree_util.tree_leaves(g2)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-5)
+
+    def test_sp_gqa_matches_unsharded(self, devices):
+        from chainermn_tpu.parallel import sp_transformer_lm_loss
+
+        rng = np.random.RandomState(1)
+        seq = 16  # divisible by 8 shards
+        tokens = rng.randint(0, VOCAB, (BATCH, seq + 1)).astype(np.int32)
+        inputs, targets = tokens[:, :-1], tokens[:, 1:]
+        params = init_tp_transformer_lm(
+            jax.random.PRNGKey(1), VOCAB, D, HEADS, LAYERS, max_len=seq,
+            n_kv_heads=2)
+
+        def run(n):
+            mesh = mn.make_mesh(devices[:n])
+            loss_fn = partial(sp_transformer_lm_loss, head_dim=HEAD_DIM,
+                              axis_name="mn")
+
+            def spmd(p, i, t):
+                return jax.lax.pmean(loss_fn(p, (i, t)), "mn")
+
+            fn = shard_map(spmd, mesh=mesh,
+                           in_specs=(P(), P(None, "mn"), P(None, "mn")),
+                           out_specs=P())
+            return float(jax.jit(fn)(params, inputs, targets))
+
+        np.testing.assert_allclose(run(8), run(1), rtol=1e-5)
