@@ -48,7 +48,12 @@ from .hybrid import (  # noqa: F401
     state_specs_like,
     zero1_specs,
 )
+from .decode import (  # noqa: F401
+    lm_generate,
+    make_lm_generator,
+)
 from .transformer import (  # noqa: F401
+    apply_rope,
     init_tp_transformer_lm,
     sp_block,
     sp_transformer_lm_loss,
@@ -103,6 +108,9 @@ __all__ = [
     "zero1_specs",
     "shard_pytree",
     "state_specs_like",
+    "apply_rope",
+    "lm_generate",
+    "make_lm_generator",
     "init_tp_transformer_lm",
     "sp_block",
     "sp_transformer_lm_loss",

@@ -1,0 +1,206 @@
+"""Autoregressive decoding with a KV cache for the TP transformer LM.
+
+Beyond-reference (the reference's only generation was seq2seq greedy
+translate): incremental decoding the TPU way —
+
+* ONE jitted program: prefill (full-prompt forward that also writes the
+  per-layer KV cache) + a ``lax.scan`` over the new tokens (static trip
+  count, static cache shapes — no dynamic shapes anywhere);
+* the cache holds the **KV heads** (GQA models cache ``n_kv_heads``, the
+  whole point of GQA at inference);
+* tensor parallelism composes: projections are column-parallel so each
+  chip caches only its local heads, the output projection's psum is the
+  only per-token cross-chip traffic, and the vocab-parallel logits are
+  argmax'd via a (max, index) pmax/psum pair — the full ``(B, V)`` logits
+  never materialize on one chip;
+* positions come from the model's ``pos_impl`` (learned table or RoPE —
+  RoPE rotates each new token at its absolute position).
+
+Layout matches :func:`transformer.init_tp_transformer_lm`; works for both
+fused-``wqkv`` and GQA (``wq``/``wkv``) attention params.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .tensor_parallel import row_parallel_dense
+from .transformer import _layer_norm, _project_qkv, apply_rope
+
+
+def lm_generate(params, prompt, rng: Optional[jax.Array] = None, *,
+                head_dim: int, axis_name: str,
+                max_new_tokens: int, temperature: float = 0.0):
+    """Generate ``max_new_tokens`` greedily (or sampled when
+    ``temperature > 0``) from ``prompt (B, S_p) int32``.
+
+    Call INSIDE ``shard_map`` with the model axis bound (use
+    :func:`make_lm_generator` for the jit face).  Returns ``(B,
+    max_new_tokens) int32``.
+    """
+    b, s_p = prompt.shape
+    d_model = params["embed"].shape[1]
+    rope = "pos_embed" not in params
+    total = s_p + max_new_tokens
+    if not rope and total > params["pos_embed"].shape[0]:
+        raise ValueError(
+            f"prompt + max_new_tokens = {total} exceeds the learned "
+            f"pos_embed max_len {params['pos_embed'].shape[0]}; shorten the "
+            f"generation or init the model with pos_impl='rope'")
+    blocks = params["blocks"]
+
+    def embed(tokens, positions):
+        from .tensor_parallel import vocab_parallel_embedding
+
+        # The table is VOCAB-SHARDED over the model axis — a plain take
+        # would index local rows with global ids.
+        x = vocab_parallel_embedding(tokens, params["embed"],
+                                     axis_name=axis_name)
+        x = x * (d_model ** 0.5)
+        if not rope:
+            x = x + jnp.take(params["pos_embed"], positions, axis=0)[None]
+        return x
+
+    def attn_block(x, blk, k_cache, v_cache, positions, write_at, q_valid):
+        """x (B,S,D) → block output; caches written at ``write_at + i`` for
+        the i-th input position; query i attends cache [:q_valid + i + 1).
+        """
+        h = _layer_norm(x, blk["ln1_scale"], blk["ln1_bias"])
+        q, k, v = _project_qkv(h, blk["attn"], head_dim, axis_name)
+        if rope:
+            q = apply_rope(q, positions)
+            k = apply_rope(k, positions)
+        k_cache = jax.lax.dynamic_update_slice_in_dim(k_cache, k, write_at, 1)
+        v_cache = jax.lax.dynamic_update_slice_in_dim(v_cache, v, write_at, 1)
+        # Per-query valid lengths make one formula serve prefill (causal)
+        # and decode (full prefix): query i sees q_valid + i + 1 entries.
+        s_q = q.shape[1]
+        valid = (q_valid + jnp.arange(s_q) + 1)[None, None, None, :, None]
+        hl, hkv = q.shape[2], k_cache.shape[2]
+        # Grouped attention against the UN-expanded cache (GQA's inference
+        # payoff): q heads regrouped onto their KV head — no per-tick
+        # n_heads-sized cache copy.
+        g = hl // hkv
+        q5 = q.reshape(b, s_q, hkv, g, head_dim)
+        s = jnp.einsum("bqhgd,bkhd->bhgqk", q5, k_cache,
+                       preferred_element_type=jnp.float32) / (head_dim ** 0.5)
+        mask = jnp.arange(k_cache.shape[1])[None, None, None, None, :] < valid
+        s = jnp.where(mask, s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        ctx = jnp.einsum("bhgqk,bkhd->bqhgd", p.astype(v_cache.dtype),
+                         v_cache,
+                         preferred_element_type=jnp.float32).astype(x.dtype)
+        ctx = ctx.reshape(b, s_q, -1)
+        attn_out = row_parallel_dense(ctx, blk["attn"]["wo"],
+                                      blk["attn"]["bo"], axis_name=axis_name)
+        x = x + attn_out
+        h = _layer_norm(x, blk["ln2_scale"], blk["ln2_bias"])
+        from .tensor_parallel import tp_mlp
+        return x + tp_mlp(h, blk["mlp"], axis_name=axis_name), k_cache, v_cache
+
+    def logits_next(h_last, step_pos):
+        """Vocab-parallel next-token choice from ``h_last (B, D)``;
+        ``step_pos`` (the position being generated) salts the sampling key
+        so every step draws FRESH Gumbel noise."""
+        table = params["embed"]
+        vocab_per = table.shape[0]
+        start = jax.lax.axis_index(axis_name) * vocab_per
+        logits = jnp.einsum("bd,vd->bv", h_last, table,
+                            preferred_element_type=jnp.float32)
+        if temperature > 0.0:
+            # Gumbel trick on the SHARDED logits: per-shard argmax of
+            # (logit/T + gumbel) then a global (value, index) max — exact
+            # categorical sampling without materializing (B, V) anywhere.
+            key = jax.random.fold_in(
+                jax.random.fold_in(rng, step_pos),
+                jax.lax.axis_index(axis_name))
+            gumbel = -jnp.log(-jnp.log(
+                jax.random.uniform(key, logits.shape, minval=1e-20)))
+            scored = logits / temperature + gumbel
+        else:
+            scored = logits
+        local_best = scored.max(-1)
+        local_idx = start + scored.argmax(-1)
+        gbest = jax.lax.pmax(local_best, axis_name)
+        # Global argmax; an exact-fp tie across shards resolves to the
+        # LOWEST winning index (argmax convention), via pmin over winners.
+        winner = (local_best == gbest)
+        return jax.lax.pmin(
+            jnp.where(winner, local_idx, jnp.int32(2 ** 30)), axis_name)
+
+    # ---- prefill: full prompt through the stack, caches written ----
+    n_kv = (blocks[0]["attn"]["wkv"].shape[1] // (2 * head_dim)
+            if "wkv" in blocks[0]["attn"]
+            else blocks[0]["attn"]["bqkv"].shape[0] // (3 * head_dim))
+    positions = jnp.arange(s_p)
+    x = embed(prompt, positions)
+    caches = []
+    for blk in blocks:
+        k0 = jnp.zeros((b, total, n_kv, head_dim), x.dtype)
+        v0 = jnp.zeros((b, total, n_kv, head_dim), x.dtype)
+        x, kc, vc = attn_block(x, blk, k0, v0, positions, 0, 0)
+        caches.append((kc, vc))
+    h = _layer_norm(x, params["lnf_scale"], params["lnf_bias"])
+    first = logits_next(h[:, -1], jnp.int32(s_p))
+
+    # ---- decode: one token per scan tick ----
+    def tick(carry, i):
+        token, caches = carry
+        pos = s_p + i - 1  # tick i consumes the (i-1)-th generated token
+        x = embed(token[:, None], pos[None])
+        new_caches = []
+        for blk, (kc, vc) in zip(blocks, caches):
+            x, kc, vc = attn_block(x, blk, kc, vc, pos[None], pos, pos)
+            new_caches.append((kc, vc))
+        h = _layer_norm(x, params["lnf_scale"], params["lnf_bias"])
+        nxt = logits_next(h[:, -1], s_p + i)
+        return (nxt, new_caches), token
+
+    (last, _), toks = jax.lax.scan(
+        tick, (first, caches), jnp.arange(1, max_new_tokens))
+    # toks carries tokens 0..max_new-2 (each tick emits its INPUT token);
+    # append the final one.
+    out = jnp.concatenate([toks.T, last[:, None]], axis=1)
+    return out.astype(jnp.int32)
+
+
+def make_lm_generator(mesh: Optional[Mesh] = None, axis_name: str = "model",
+                      *, head_dim: int, max_new_tokens: int,
+                      temperature: float = 0.0):
+    """Eager/jit face: ``fn(params, prompt[, rng]) -> (B, max_new) tokens``
+    over TP-sharded global params (``transformer_lm_specs`` layout)."""
+    from jax import shard_map
+
+    from .transformer import transformer_lm_specs
+
+    if mesh is None:
+        from ..topology import make_mesh
+        mesh = make_mesh(axis_name=axis_name)
+
+    cache = {}  # one compiled program per param STRUCTURE (spec pytree)
+
+    def apply(params, prompt, rng=None):
+        specs = transformer_lm_specs(params, axis_name)
+        if rng is None:
+            rng = jax.random.PRNGKey(0)
+        key = jax.tree_util.tree_structure(specs)
+        if key not in cache:
+            cache[key] = jax.jit(shard_map(
+                partial(lm_generate, head_dim=head_dim, axis_name=axis_name,
+                        max_new_tokens=max_new_tokens,
+                        temperature=temperature),
+                mesh=mesh,
+                in_specs=(specs, P(), P()),
+                out_specs=P(),
+            ))
+        sharded = jax.tree_util.tree_map(
+            lambda x, sp: jax.device_put(x, NamedSharding(mesh, sp)),
+            params, specs)
+        return cache[key](sharded, prompt, rng)
+
+    return apply

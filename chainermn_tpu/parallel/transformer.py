@@ -42,8 +42,58 @@ def _layer_norm(x, scale, bias, eps=1e-5):
     return (y * scale + bias).astype(x.dtype)
 
 
+def apply_rope(x, positions, *, base: float = 10000.0):
+    """Rotary position embedding over ``(B, S, H, head_dim)``.
+
+    Beyond-reference (learned absolute positions were already beyond the
+    2017 reference; RoPE is the long-context-era standard — relative
+    attention decay, extrapolation-friendly): rotate each head-dim pair by
+    ``position · base^(-2i/d)``.  ``positions (S,)`` are GLOBAL token
+    positions, so sequence-parallel shards pass ``my_shard_offset +
+    arange(S_local)`` and the ring stays exact.  ``head_dim`` must be even.
+    """
+    half = x.shape[-1] // 2
+    if x.shape[-1] % 2:
+        raise ValueError(f"RoPE needs an even head_dim, got {x.shape[-1]}")
+    freqs = base ** (-jnp.arange(half, dtype=jnp.float32) / half)
+    ang = positions.astype(jnp.float32)[:, None] * freqs[None]     # (S, half)
+    cos = jnp.cos(ang)[None, :, None, :]
+    sin = jnp.sin(ang)[None, :, None, :]
+    x1, x2 = x[..., :half].astype(jnp.float32), x[..., half:].astype(jnp.float32)
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], -1).astype(x.dtype)
+
+
+def _project_qkv(h, a, head_dim: int, axis_name: str):
+    """Shared QKV projection for both attention param layouts: returns
+    local ``q (B, S, Hl, hd)`` and ``k, v (B, S, Hkv_l, hd)``.
+
+    Works for TP-sharded weights (column shards produce local heads) and
+    replicated weights (SP blocks — full heads) alike, since
+    ``column_parallel_dense`` is a local matmul.  Single home for the
+    fused-``wqkv`` vs GQA-``wq``/``wkv`` branch used by ``tp_attention``,
+    ``sp_block`` and the KV-cache decoder.
+    """
+    b, s, _ = h.shape
+    if "wq" in a:
+        q = column_parallel_dense(h, a["wq"], a["bq"], axis_name=axis_name)
+        q = q.reshape(b, s, -1, head_dim)
+        kv = column_parallel_dense(h, a["wkv"], a["bkv"], axis_name=axis_name)
+        if kv.shape[-1] % (2 * head_dim):
+            raise ValueError(
+                f"local wkv shard width {kv.shape[-1]} is not a whole "
+                f"number of KV heads (2*head_dim={2 * head_dim}) — "
+                f"n_kv_heads must be divisible by the model-axis size")
+        kv = kv.reshape(b, s, -1, 2, head_dim)
+        return q, kv[..., 0, :], kv[..., 1, :]
+    qkv = column_parallel_dense(h, a["wqkv"], a["bqkv"], axis_name=axis_name)
+    qkv = qkv.reshape(b, s, -1, 3, head_dim)
+    return qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
+
+
 def tp_attention(x, params, *, head_dim: int, axis_name: str,
-                 causal: bool = True, attn_impl: str = "xla"):
+                 causal: bool = True, attn_impl: str = "xla",
+                 positions=None):
     """Multi-head self-attention with heads sharded over ``axis_name``.
 
     ``x``: replicated-local ``(B, S, D)``; ``params``: local shards
@@ -54,31 +104,12 @@ def tp_attention(x, params, *, head_dim: int, axis_name: str,
     row-parallel output projection) per call.
     """
     b, s, d = x.shape
+    q, k, v = _project_qkv(x, params, head_dim, axis_name)
+    h_local = q.shape[2]
 
-    if "wq" in params:
-        # GQA layout: separate q and fused kv projections, both
-        # column-parallel (q heads and kv heads each sharded over the model
-        # axis; spec requires n_kv_heads % P == 0 so groups stay aligned).
-        q = column_parallel_dense(x, params["wq"], params["bq"],
-                                  axis_name=axis_name)
-        h_local = q.shape[-1] // head_dim
-        q = q.reshape(b, s, h_local, head_dim)
-        kv = column_parallel_dense(x, params["wkv"], params["bkv"],
-                                   axis_name=axis_name)
-        if kv.shape[-1] % (2 * head_dim):
-            raise ValueError(
-                f"local wkv shard width {kv.shape[-1]} is not a whole number "
-                f"of KV heads (2*head_dim={2 * head_dim}) — n_kv_heads must "
-                f"be divisible by the model-axis size")
-        hkv_local = kv.shape[-1] // (2 * head_dim)
-        kv = kv.reshape(b, s, hkv_local, 2, head_dim)
-        k, v = kv[..., 0, :], kv[..., 1, :]
-    else:
-        h_local = params["bqkv"].shape[0] // (3 * head_dim)
-        qkv = column_parallel_dense(x, params["wqkv"], params["bqkv"],
-                                    axis_name=axis_name)    # (B, S, 3·Dl)
-        qkv = qkv.reshape(b, s, h_local, 3, head_dim)
-        q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
+    if positions is not None:  # RoPE (positions are global token indices)
+        q = apply_rope(q, positions)
+        k = apply_rope(k, positions)
 
     if attn_impl == "flash":
         from ..ops.flash_attention import flash_attention
@@ -104,12 +135,12 @@ def tp_attention(x, params, *, head_dim: int, axis_name: str,
 
 
 def tp_block(x, params, *, head_dim: int, axis_name: str, causal: bool = True,
-             attn_impl: str = "xla"):
+             attn_impl: str = "xla", positions=None):
     """Pre-norm transformer block: LN→attn→residual, LN→MLP→residual."""
     h = _layer_norm(x, params["ln1_scale"], params["ln1_bias"])
     x = x + tp_attention(h, params["attn"], head_dim=head_dim,
                          axis_name=axis_name, causal=causal,
-                         attn_impl=attn_impl)
+                         attn_impl=attn_impl, positions=positions)
     h = _layer_norm(x, params["ln2_scale"], params["ln2_bias"])
     return x + tp_mlp(h, params["mlp"], axis_name=axis_name)
 
@@ -156,17 +187,21 @@ def tp_transformer_lm_loss(params, batch, *, head_dim: int, axis_name: str,
 
     x = vocab_parallel_embedding(inputs, params["embed"], axis_name=axis_name)
     x = x * (params["embed"].shape[1] ** 0.5)
-    x = x + params["pos_embed"][: x.shape[1]][None]
+    positions = None
+    if "pos_embed" in params:
+        x = x + params["pos_embed"][: x.shape[1]][None]
+    else:  # RoPE model (init with pos_impl='rope'): rotate inside attention
+        positions = jnp.arange(x.shape[1])
     for blk in params["blocks"]:
         x = tp_block(x, blk, head_dim=head_dim, axis_name=axis_name,
-                     causal=causal, attn_impl=attn_impl)
+                     causal=causal, attn_impl=attn_impl, positions=positions)
     x = _layer_norm(x, params["lnf_scale"], params["lnf_bias"])
     return vocab_parallel_logits_loss(x, params["embed"], targets,
                                       axis_name=axis_name)
 
 
 def sp_block(x, params, *, head_dim: int, axis_name: str, causal: bool = True,
-             attn_impl: str = "xla", sp_impl: str = "ring"):
+             attn_impl: str = "xla", sp_impl: str = "ring", positions=None):
     """Transformer block with the SEQUENCE sharded over ``axis_name``.
 
     The long-context configuration (first-class per the rebuild brief;
@@ -187,19 +222,14 @@ def sp_block(x, params, *, head_dim: int, axis_name: str, causal: bool = True,
     n_heads = d // head_dim
     a = params["attn"]
     h = _layer_norm(x, params["ln1_scale"], params["ln1_bias"])
-    if "wq" in a:  # GQA: fewer KV heads ride the ring / all-to-all
-        q = (jnp.matmul(h, a["wq"], preferred_element_type=jnp.float32)
-             .astype(x.dtype) + a["bq"]).reshape(b, s_local, n_heads, head_dim)
-        kv = (jnp.matmul(h, a["wkv"], preferred_element_type=jnp.float32)
-              .astype(x.dtype) + a["bkv"])
-        n_kv = kv.shape[-1] // (2 * head_dim)
-        kv = kv.reshape(b, s_local, n_kv, 2, head_dim)
-        k, v = kv[..., 0, :], kv[..., 1, :]
-    else:
-        qkv = jnp.matmul(h, a["wqkv"],
-                         preferred_element_type=jnp.float32).astype(x.dtype)
-        qkv = (qkv + a["bqkv"]).reshape(b, s_local, n_heads, 3, head_dim)
-        q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
+    # Params are replicated here, so the shared projection yields FULL
+    # heads (GQA: fewer KV heads ride the ring / all-to-all).
+    q, k, v = _project_qkv(h, a, head_dim, axis_name)
+    if positions is not None:
+        # RoPE with GLOBAL positions: each shard rotates by its own offsets
+        # before K/V ride the ring, so relative phases stay exact.
+        q = apply_rope(q, positions)
+        k = apply_rope(k, positions)
     if sp_impl == "ring":
         ctx = ring_attention(q, k, v, axis_name=axis_name, causal=causal,
                              attn_impl=attn_impl)
@@ -238,21 +268,25 @@ def sp_transformer_lm_loss(params, batch, *, head_dim: int, axis_name: str,
     my = jax.lax.axis_index(axis_name)
     s_local = inputs.shape[1]
     s_global = jax.lax.axis_size(axis_name) * s_local
-    max_len = params["pos_embed"].shape[0]
-    if s_global > max_len:
-        # jnp.take would silently CLAMP out-of-range positions to the last
-        # pos_embed row — degenerate positional info, no error.  Fail loud.
-        raise ValueError(
-            f"global sequence {s_global} exceeds pos_embed max_len "
-            f"{max_len}; re-init the model with max_len >= {s_global}")
-
+    pos = my * s_local + jnp.arange(s_local)
     x = jnp.take(params["embed"], inputs, axis=0)
     x = x * (params["embed"].shape[1] ** 0.5)
-    pos = my * s_local + jnp.arange(s_local)
-    x = x + jnp.take(params["pos_embed"], pos, axis=0)[None]
+    positions = None
+    if "pos_embed" in params:
+        max_len = params["pos_embed"].shape[0]
+        if s_global > max_len:
+            # jnp.take would silently CLAMP out-of-range positions to the
+            # last pos_embed row — degenerate positional info, no error.
+            raise ValueError(
+                f"global sequence {s_global} exceeds pos_embed max_len "
+                f"{max_len}; re-init the model with max_len >= {s_global}")
+        x = x + jnp.take(params["pos_embed"], pos, axis=0)[None]
+    else:  # RoPE: no length cap, rotation happens inside attention
+        positions = pos
     for blk in params["blocks"]:
         x = sp_block(x, blk, head_dim=head_dim, axis_name=axis_name,
-                     causal=causal, attn_impl=attn_impl, sp_impl=sp_impl)
+                     causal=causal, attn_impl=attn_impl, sp_impl=sp_impl,
+                     positions=positions)
     x = _layer_norm(x, params["lnf_scale"], params["lnf_bias"])
     logits = jnp.einsum("bsd,vd->bsv", x, params["embed"],
                         preferred_element_type=jnp.float32)
@@ -266,7 +300,8 @@ def sp_transformer_lm_loss(params, batch, *, head_dim: int, axis_name: str,
 def init_tp_transformer_lm(rng, vocab: int, d_model: int, n_heads: int,
                            n_layers: int, d_hidden: Optional[int] = None,
                            max_len: int = 512, dtype=jnp.float32,
-                           n_kv_heads: Optional[int] = None) -> Dict[str, Any]:
+                           n_kv_heads: Optional[int] = None,
+                           pos_impl: str = "learned") -> Dict[str, Any]:
     """GLOBAL (unsharded) parameter pytree for the TP transformer LM.
 
     ``n_kv_heads`` (GQA/MQA): when set below ``n_heads``, attention carries
@@ -274,7 +309,13 @@ def init_tp_transformer_lm(rng, vocab: int, d_model: int, n_heads: int,
     of the fused ``wqkv``; the KV cache and projection shrink by
     ``n_heads / n_kv_heads``.  Under TP, ``n_kv_heads`` must stay divisible
     by the model-axis size.
+
+    ``pos_impl``: ``'learned'`` (absolute ``pos_embed`` table, capped at
+    ``max_len``) or ``'rope'`` (rotary, :func:`apply_rope` — no table, no
+    length cap; the loss builders detect the absent ``pos_embed`` key).
     """
+    if pos_impl not in ("learned", "rope"):
+        raise ValueError(f"pos_impl must be 'learned' or 'rope', got {pos_impl!r}")
     if d_model % n_heads:
         raise ValueError(f"d_model {d_model} not divisible by n_heads {n_heads}")
     if n_kv_heads is not None and n_heads % n_kv_heads:
@@ -332,15 +373,17 @@ def init_tp_transformer_lm(rng, vocab: int, d_model: int, n_heads: int,
                 "bo": jnp.zeros((d_model,), dtype),
             },
         })
-    return {
+    out = {
         "embed": (jax.random.normal(keys[0], (vocab, d_model))
                   * scale(d_model)).astype(dtype),
-        "pos_embed": (jax.random.normal(keys[1], (max_len, d_model))
-                      * 0.02).astype(dtype),
         "blocks": blocks,
         "lnf_scale": jnp.ones((d_model,), dtype),
         "lnf_bias": jnp.zeros((d_model,), dtype),
     }
+    if pos_impl == "learned":
+        out["pos_embed"] = (jax.random.normal(keys[1], (max_len, d_model))
+                            * 0.02).astype(dtype)
+    return out
 
 
 def transformer_lm_specs(params, axis_name: str = "model"):
@@ -369,10 +412,12 @@ def transformer_lm_specs(params, axis_name: str = "model"):
                     "wo": P(ax, None), "bo": P()},
         }
 
-    return {
+    out = {
         "embed": P(ax, None),
-        "pos_embed": P(),
         "blocks": [block_specs(b) for b in params["blocks"]],
         "lnf_scale": P(),
         "lnf_bias": P(),
     }
+    if "pos_embed" in params:
+        out["pos_embed"] = P()
+    return out

@@ -218,3 +218,87 @@ class TestGQATransformer:
             return float(jax.jit(fn)(params, inputs, targets))
 
         np.testing.assert_allclose(run(8), run(1), rtol=1e-5)
+
+
+class TestRoPE:
+    """Rotary positions (pos_impl='rope'): no pos_embed table, rotation in
+    attention with GLOBAL positions — TP and SP sharding must not change
+    the math."""
+
+    def _rope_params(self, seed=0, **kw):
+        return init_tp_transformer_lm(
+            jax.random.PRNGKey(seed), VOCAB, D, HEADS, LAYERS, max_len=SEQ,
+            pos_impl="rope", **kw)
+
+    def test_no_pos_embed_table(self):
+        params = self._rope_params()
+        assert "pos_embed" not in params
+        assert "pos_embed" not in transformer_lm_specs(params, "model")
+
+    def test_rope_relative_shift_property(self):
+        """Rotating q and k at positions p and p+delta gives the same score
+        as positions 0 and delta — the defining relative property."""
+        from chainermn_tpu.parallel import apply_rope
+
+        rng = np.random.RandomState(0)
+        q = jnp.asarray(rng.randn(1, 1, 1, 8), jnp.float32)
+        k = jnp.asarray(rng.randn(1, 1, 1, 8), jnp.float32)
+
+        def score(q_pos, k_pos):
+            qr = apply_rope(q, jnp.asarray([q_pos]))
+            kr = apply_rope(k, jnp.asarray([k_pos]))
+            return float(jnp.sum(qr * kr))
+
+        np.testing.assert_allclose(score(7, 3), score(4, 0), rtol=1e-5)
+        np.testing.assert_allclose(score(100, 98), score(2, 0), rtol=1e-5)
+
+    def test_tp2_matches_tp1(self, devices):
+        params = self._rope_params()
+        rng = np.random.RandomState(0)
+        tokens = rng.randint(0, VOCAB, (BATCH, SEQ + 1)).astype(np.int32)
+        l1, g1 = run_loss(mn.make_nd_mesh(("data", "model"), (4, 1),
+                                          devices[:4]), (4, 1),
+                          params, (tokens,))
+        l2, g2 = run_loss(mn.make_nd_mesh(("data", "model"), (4, 2), devices),
+                          (4, 2), params, (tokens,))
+        np.testing.assert_allclose(l1, l2, rtol=1e-5)
+        for a, b in zip(jax.tree_util.tree_leaves(g1),
+                        jax.tree_util.tree_leaves(g2)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-5)
+
+    def test_sp8_matches_sp1(self, devices):
+        """SP shards rotate with their own GLOBAL offsets; 8-shard loss must
+        equal unsharded — this is the test that catches local-position
+        bugs (rotating every shard from 0 would silently 'work')."""
+        from chainermn_tpu.parallel import sp_transformer_lm_loss
+
+        params = self._rope_params(seed=2)
+        rng = np.random.RandomState(2)
+        seq = 16
+        tokens = rng.randint(0, VOCAB, (BATCH, seq + 1)).astype(np.int32)
+        inputs, targets = tokens[:, :-1], tokens[:, 1:]
+
+        def run(n):
+            mesh = mn.make_mesh(devices[:n])
+            loss_fn = partial(sp_transformer_lm_loss, head_dim=HEAD_DIM,
+                              axis_name="mn")
+
+            def spmd(p, i, t):
+                return jax.lax.pmean(loss_fn(p, (i, t)), "mn")
+
+            fn = shard_map(spmd, mesh=mesh,
+                           in_specs=(P(), P(None, "mn"), P(None, "mn")),
+                           out_specs=P())
+            return float(jax.jit(fn)(params, inputs, targets))
+
+        np.testing.assert_allclose(run(8), run(1), rtol=1e-5)
+
+    def test_rope_with_gqa(self, devices):
+        params = self._rope_params(seed=3, n_kv_heads=2)
+        rng = np.random.RandomState(3)
+        tokens = rng.randint(0, VOCAB, (BATCH, SEQ + 1)).astype(np.int32)
+        mesh = mn.make_nd_mesh(("data", "model"), (4, 2), devices)
+        loss, grads = run_loss(mesh, (4, 2), params, (tokens,))
+        assert np.isfinite(loss)
+        assert loss < np.log(VOCAB) * 3
