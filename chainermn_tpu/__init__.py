@@ -43,7 +43,12 @@ from .datasets import (  # noqa: F401
     scatter_dataset,
     scatter_index,
 )
-from .evaluators import accuracy_evaluator, create_multi_node_evaluator  # noqa: F401
+from .evaluators import (  # noqa: F401
+    accuracy_evaluator,
+    bleu_evaluator,
+    corpus_bleu,
+    create_multi_node_evaluator,
+)
 from .optimizers import (  # noqa: F401
     compressed_mean,
     create_multi_node_optimizer,

@@ -21,6 +21,18 @@ from .communicators.base import CommunicatorBase
 from .datasets import ScatteredDataset
 
 
+def _as_shards(scattered, communicator) -> Sequence:
+    """Normalize evaluator input to the list of shards THIS process should
+    evaluate: all ranks' shards single-controller, only the local shard
+    under multi-controller (the cross-process combine then pools exactly
+    once — and nobody re-decodes the whole corpus P times)."""
+    if isinstance(scattered, ScatteredDataset):
+        if communicator.inter_size > 1:
+            return [scattered.local()]
+        return [scattered.shard(r) for r in range(len(scattered))]
+    return list(scattered)
+
+
 def create_multi_node_evaluator(actual_evaluator: Callable, communicator: CommunicatorBase):
     """Wrap ``actual_evaluator`` for multi-rank evaluation.
 
@@ -32,11 +44,7 @@ def create_multi_node_evaluator(actual_evaluator: Callable, communicator: Commun
     """
 
     def evaluate(scattered) -> Dict[str, float]:
-        shards: Sequence = (
-            [scattered.shard(r) for r in range(len(scattered))]
-            if isinstance(scattered, ScatteredDataset)
-            else list(scattered)
-        )
+        shards = _as_shards(scattered, communicator)
         totals: Dict[str, float] = {}
         weights: Dict[str, float] = {}
         for shard in shards:
@@ -80,5 +88,105 @@ def accuracy_evaluator(predict_fn: Callable, batch_size: int = 256):
             total += len(ys)
         return {"validation/loss": loss_sum / max(total, 1),
                 "validation/accuracy": correct / max(total, 1)}
+
+    return evaluate
+
+
+def _bleu_counts(references, hypotheses, max_n):
+    """Sufficient statistics for corpus BLEU: clipped n-gram matches,
+    totals, and lengths — these POOL ADDITIVELY across data shards, which
+    is what lets the distributed evaluator combine processes exactly."""
+    from collections import Counter
+
+    hyp_len = ref_len = 0
+    match = [0] * max_n
+    total = [0] * max_n
+    for ref, hyp in zip(references, hypotheses):
+        ref, hyp = list(ref), list(hyp)
+        hyp_len += len(hyp)
+        ref_len += len(ref)
+        for n in range(1, max_n + 1):
+            h_ngrams = Counter(tuple(hyp[i:i + n])
+                               for i in range(len(hyp) - n + 1))
+            r_ngrams = Counter(tuple(ref[i:i + n])
+                               for i in range(len(ref) - n + 1))
+            total[n - 1] += max(len(hyp) - n + 1, 0)
+            match[n - 1] += sum((h_ngrams & r_ngrams).values())
+    return match, total, hyp_len, ref_len
+
+
+def _bleu_from_counts(match, total, hyp_len, ref_len, max_n, smooth):
+    import math
+
+    log_p = 0.0
+    for n in range(max_n):
+        m, t = match[n], total[n]
+        if smooth and n > 0:
+            m, t = m + 1, t + 1
+        if m == 0 or t == 0:
+            return 0.0
+        log_p += math.log(m / t)
+    bp = (1.0 if hyp_len >= ref_len
+          else math.exp(1.0 - ref_len / max(hyp_len, 1)))
+    return bp * math.exp(log_p / max_n)
+
+
+def corpus_bleu(references: Sequence[Sequence[int]],
+                hypotheses: Sequence[Sequence[int]],
+                max_n: int = 4, smooth: bool = True) -> float:
+    """Corpus-level BLEU over token-id sequences (no nltk dependency).
+
+    Reference parity: the reference's seq2seq example scored translations
+    with BLEU via an nltk-backed trainer extension (``examples/seq2seq``
+    [uv], SURVEY.md §2.9 BASELINE config #3).  Standard Papineni BLEU:
+    clipped modified n-gram precision up to ``max_n``, geometric mean,
+    brevity penalty; ``smooth`` adds +1 smoothing on n>1 precisions so one
+    missing 4-gram doesn't zero a short corpus.
+    """
+    if len(references) != len(hypotheses):
+        raise ValueError(f"{len(references)} references vs "
+                         f"{len(hypotheses)} hypotheses")
+    counts = _bleu_counts(references, hypotheses, max_n)
+    return _bleu_from_counts(*counts, max_n, smooth)
+
+
+def bleu_evaluator(translate_fn: Callable, communicator: CommunicatorBase,
+                   max_n: int = 4, smooth: bool = True):
+    """Distributed BLEU: each rank translates its shard, n-gram COUNT
+    statistics pool across processes (BLEU does not decompose into a
+    per-shard mean), one corpus score comes back everywhere.
+
+    ``translate_fn(sources) -> list of token-id lists``.  Returns a
+    callable ``(scattered_pairs) -> {"bleu": float}`` where each example is
+    ``(source_tokens, reference_tokens)``.
+    """
+
+    def evaluate(scattered) -> Dict[str, float]:
+        shards = _as_shards(scattered, communicator)
+        refs: list = []
+        hyps: list = []
+        for shard in shards:
+            srcs = [ex[0] for ex in shard]
+            outs = [list(h) for h in translate_fn(srcs)]
+            if len(outs) != len(srcs):
+                raise ValueError(
+                    f"translate_fn returned {len(outs)} hypotheses for "
+                    f"{len(srcs)} sources — a silent zip would misalign "
+                    f"every later pair")
+            refs.extend([list(ex[1]) for ex in shard])
+            hyps.extend(outs)
+        match, total, hyp_len, ref_len = _bleu_counts(refs, hyps, max_n)
+        if communicator.inter_size > 1:
+            # Pool the additive statistics across processes (same combine
+            # pattern as create_multi_node_evaluator).
+            match, total, hyp_len, ref_len = communicator.allreduce_obj(
+                (match, total, hyp_len, ref_len),
+                op=lambda a, b: (
+                    [x + y for x, y in zip(a[0], b[0])],
+                    [x + y for x, y in zip(a[1], b[1])],
+                    a[2] + b[2], a[3] + b[3]),
+            )
+        return {"bleu": _bleu_from_counts(match, total, hyp_len, ref_len,
+                                          max_n, smooth)}
 
     return evaluate

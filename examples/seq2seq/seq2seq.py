@@ -152,6 +152,20 @@ def main():
         ok = out_toks == src_toks[::-1]
         print(f"src={src_toks} → out={out_toks} {'✓' if ok else '✗'}")
 
+    # Corpus BLEU over the whole validation set (reference parity: the
+    # reference's seq2seq scored its translations with BLEU).
+    def translate_fn(srcs):
+        src_arr, _, _ = encode_pairs(
+            [(list(s), list(s)) for s in srcs], args.bucket, args.bucket)
+        out = np.asarray(model.apply(
+            updater.state[0], src_arr, max_len=args.bucket,
+            method=Seq2seq.translate))
+        return [[int(t) for t in row if t not in (PAD, EOS)] for row in out]
+
+    # val_pairs already holds the ragged (source, reversed-source) examples.
+    bleu_eval = mn.bleu_evaluator(translate_fn, comm)
+    print(f"validation BLEU: {bleu_eval([val_pairs])['bleu']:.4f}")
+
 
 if __name__ == "__main__":
     main()

@@ -1,0 +1,67 @@
+"""Corpus BLEU tests (reference parity: the reference's seq2seq example
+scored translations with nltk BLEU; ours is dependency-free)."""
+
+import numpy as np
+import pytest
+
+import chainermn_tpu as mn
+from chainermn_tpu.evaluators import bleu_evaluator, corpus_bleu
+
+
+class TestCorpusBleu:
+    def test_perfect_match_is_one(self):
+        refs = [[1, 2, 3, 4, 5], [7, 8, 9, 10]]
+        assert corpus_bleu(refs, refs) == pytest.approx(1.0)
+
+    def test_disjoint_is_zero(self):
+        assert corpus_bleu([[1, 2, 3, 4, 5]], [[6, 7, 8, 9, 10]]) == 0.0
+
+    def test_known_value_unsmoothed(self):
+        # hyp shares 4/5 unigrams, 3/4 bigrams, 2/3 trigrams, 1/2 4-grams
+        ref = [1, 2, 3, 4, 5]
+        hyp = [1, 2, 3, 4, 9]
+        want = (4 / 5 * 3 / 4 * 2 / 3 * 1 / 2) ** 0.25  # BP = 1 (equal len)
+        assert corpus_bleu([ref], [hyp], smooth=False) == pytest.approx(want)
+
+    def test_brevity_penalty(self):
+        ref = [1, 2, 3, 4, 5, 6, 7, 8]
+        hyp = [1, 2, 3, 4]  # perfect n-gram precision, half length
+        got = corpus_bleu([ref], [hyp], smooth=False)
+        assert got == pytest.approx(np.exp(1 - 8 / 4), rel=1e-6)
+
+    def test_corpus_pools_not_averages(self):
+        """BLEU of a corpus != mean of per-sentence BLEUs (the reason the
+        distributed evaluator pools counts instead of averaging scores)."""
+        refs = [[1, 2, 3, 4, 5], [1, 2, 3]]
+        hyps = [[1, 2, 3, 4, 5], [7, 8, 9]]
+        per_sent = (corpus_bleu([refs[0]], [hyps[0]], smooth=False)
+                    + corpus_bleu([refs[1]], [hyps[1]], smooth=False)) / 2
+        pooled = corpus_bleu(refs, hyps, smooth=False)
+        assert pooled != pytest.approx(per_sent)
+
+    def test_length_mismatch_raises(self):
+        with pytest.raises(ValueError, match="references"):
+            corpus_bleu([[1]], [[1], [2]])
+
+
+class TestBleuEvaluator:
+    def test_identity_translator_scores_one(self):
+        comm = mn.create_communicator("xla")
+        ev = bleu_evaluator(lambda srcs: [list(s) for s in srcs], comm)
+        shard = [([1, 2, 3, 4], [1, 2, 3, 4]), ([5, 6, 7, 8], [5, 6, 7, 8])]
+        assert ev([shard])["bleu"] == pytest.approx(1.0)
+
+    def test_matches_direct_corpus_bleu(self):
+        comm = mn.create_communicator("xla")
+        rng = np.random.RandomState(0)
+        pairs = [(rng.randint(0, 9, 6).tolist(),
+                  rng.randint(0, 9, 6).tolist()) for _ in range(10)]
+
+        def noisy(srcs):
+            return [list(s[:-1]) + [0] for s in srcs]
+
+        ev = bleu_evaluator(noisy, comm)
+        got = ev([pairs])["bleu"]
+        want = corpus_bleu([list(r) for _, r in pairs],
+                           noisy([s for s, _ in pairs]))
+        assert got == pytest.approx(want)
