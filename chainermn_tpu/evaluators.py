@@ -23,11 +23,16 @@ from .datasets import ScatteredDataset
 
 def _as_shards(scattered, communicator) -> Sequence:
     """Normalize evaluator input to the list of shards THIS process should
-    evaluate: all ranks' shards single-controller, only the local shard
-    under multi-controller (the cross-process combine then pools exactly
-    once — and nobody re-decodes the whole corpus P times)."""
+    evaluate: all ranks' shards single-controller; under multi-controller,
+    the shards of EVERY rank this process owns (one per local device — not
+    just ``local()``'s first rank), so the cross-process combine pools each
+    shard exactly once and nobody re-evaluates the whole corpus P times."""
     if isinstance(scattered, ScatteredDataset):
         if communicator.inter_size > 1:
+            owned = [r for r in range(min(len(scattered), communicator.size))
+                     if communicator.owns_rank(r)]
+            if owned:
+                return [scattered.shard(r) for r in owned]
             return [scattered.local()]
         return [scattered.shard(r) for r in range(len(scattered))]
     return list(scattered)

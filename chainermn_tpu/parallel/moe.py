@@ -34,8 +34,9 @@ from ..topology import DEFAULT_AXIS_NAME
 
 
 def moe_mlp(x, params, *, axis_name: str, num_experts: int,
-            capacity_factor: float = 1.25, activation=jax.nn.gelu):
-    """Top-1 (Switch) MoE MLP over expert-sharded weights.
+            capacity_factor: float = 1.25, activation=jax.nn.gelu,
+            router_topk: int = 1):
+    """Top-1 (Switch) or top-2 (GShard) MoE MLP over expert-sharded weights.
 
     Call INSIDE ``shard_map``.  ``x``: local token shard ``(T, D)`` (token/
     batch axis sharded over ``axis_name``).  ``params``:
@@ -45,26 +46,33 @@ def moe_mlp(x, params, *, axis_name: str, num_experts: int,
       ``bo (E_local, D)``: this device's expert shards (``in_spec
       P(axis_name)`` over globally expert-stacked weights).
 
+    ``router_topk=2`` routes each token to its two best experts with
+    normalized gates (GShard): second choices queue BEHIND all first
+    choices at their expert, so under capacity pressure first choices win —
+    the standard priority rule.  Capacity scales with ``router_topk``.
+
     Returns ``(y, aux_loss)``: ``y (T, D)`` with dropped tokens zero,
     ``aux_loss`` the load-balancing scalar (already globally averaged).
     """
+    if router_topk not in (1, 2):
+        raise ValueError(f"router_topk must be 1 or 2, got {router_topk}")
     p_size = jax.lax.axis_size(axis_name)
     e = num_experts
     if e % p_size != 0:
         raise ValueError(f"num_experts {e} not divisible by axis size {p_size}")
     e_local = e // p_size
     t, d = x.shape
-    capacity = int(math.ceil(t / e * capacity_factor))
+    capacity = int(math.ceil(router_topk * t / e * capacity_factor))
 
-    # --- route: top-1 per token, fp32 softmax for stable gating ---
+    # --- route: fp32 softmax for stable gating ---
     logits = jnp.matmul(x, params["router"],
                         preferred_element_type=jnp.float32)  # (T, E)
     probs = jax.nn.softmax(logits, axis=-1)
     expert_idx = jnp.argmax(probs, axis=-1)                  # (T,)
     onehot = jax.nn.one_hot(expert_idx, e, dtype=probs.dtype)  # (T, E)
-    gate = jnp.sum(probs * onehot, axis=-1)                  # (T,)
+    gate1 = jnp.sum(probs * onehot, axis=-1)                 # (T,)
 
-    # Load-balancing aux (Switch eq. 4) over GLOBAL batch statistics:
+    # Load-balancing aux (Switch eq. 4) over GLOBAL first-choice statistics:
     # fraction_e and mean_prob_e are each pmean'd across devices BEFORE the
     # product (mean-of-products ≠ product-of-means when routing is skewed
     # across devices), so the scalar equals the single-device computation on
@@ -82,7 +90,29 @@ def moe_mlp(x, params, *, axis_name: str, num_experts: int,
     pos_onehot = jax.nn.one_hot(pos_idx, capacity, dtype=x.dtype)  # (T, C)
     dispatch = (onehot.astype(x.dtype)[:, :, None] * pos_onehot[:, None, :]
                 * keep[:, None, None])                       # (T, E, C)
-    combine = dispatch * gate.astype(x.dtype)[:, None, None]  # (T, E, C)
+
+    if router_topk == 2:
+        probs2 = probs * (1.0 - onehot)  # mask the first choice
+        idx2 = jnp.argmax(probs2, axis=-1)
+        onehot2 = jax.nn.one_hot(idx2, e, dtype=probs.dtype)
+        gate2 = jnp.sum(probs * onehot2, axis=-1)
+        # Second choices queue behind ALL first choices at their expert.
+        first_counts = jnp.sum(onehot, axis=0)               # (E,)
+        position2 = (jnp.cumsum(onehot2, axis=0) - 1.0) * onehot2
+        pos2_idx = (jnp.sum(position2 + first_counts[None] * onehot2,
+                            axis=-1)).astype(jnp.int32)
+        keep2 = pos2_idx < capacity
+        pos2_onehot = jax.nn.one_hot(pos2_idx, capacity, dtype=x.dtype)
+        dispatch2 = (onehot2.astype(x.dtype)[:, :, None]
+                     * pos2_onehot[:, None, :] * keep2[:, None, None])
+        # Normalized gates over the two choices (standard GShard combine).
+        denom = jnp.maximum(gate1 + gate2, 1e-9)
+        combine = (dispatch * (gate1 / denom).astype(x.dtype)[:, None, None]
+                   + dispatch2
+                   * (gate2 / denom).astype(x.dtype)[:, None, None])
+        dispatch = dispatch + dispatch2
+    else:
+        combine = dispatch * gate1.astype(x.dtype)[:, None, None]  # (T, E, C)
 
     # --- to experts: (T,E,C)×(T,D) → (E,C,D), then all_to_all over ICI ---
     expert_in = jnp.einsum("tec,td->ecd", dispatch, x)
@@ -142,7 +172,8 @@ def moe_mlp_specs(axis_name: str = DEFAULT_AXIS_NAME) -> dict:
 
 def make_moe_mlp(num_experts: int, mesh: Optional[Mesh] = None,
                  axis_name: Optional[str] = None,
-                 capacity_factor: float = 1.25, activation=jax.nn.gelu):
+                 capacity_factor: float = 1.25, activation=jax.nn.gelu,
+                 router_topk: int = 1):
     """Eager/jit face: ``fn(x, global_params) -> (y, aux)`` over global
     arrays, tokens sharded over the mesh axis; compiles once per shape."""
     from ._factory import make_global_apply, resolve_mesh_axis
@@ -151,5 +182,6 @@ def make_moe_mlp(num_experts: int, mesh: Optional[Mesh] = None,
     specs = moe_mlp_specs(ax)
     return make_global_apply(
         partial(moe_mlp, axis_name=ax, num_experts=num_experts,
-                capacity_factor=capacity_factor, activation=activation),
+                capacity_factor=capacity_factor, activation=activation,
+                router_topk=router_topk),
         mesh, (P(ax), specs), (P(ax), P()))

@@ -53,6 +53,9 @@ def main():
     parser.add_argument("--lr", type=float, default=3e-2)
     parser.add_argument("--aux-weight", type=float, default=0.01)
     parser.add_argument("--capacity-factor", type=float, default=1.5)
+    parser.add_argument("--router-topk", type=int, default=1,
+                        choices=[1, 2],
+                        help="1 = Switch top-1, 2 = GShard top-2 routing")
     args = parser.parse_args()
 
     if args.devices:
@@ -92,7 +95,8 @@ def main():
         xs, ys = batch
         h = jnp.tanh(xs @ p["w_in"])
         y, aux = moe_mlp(h, p["moe"], axis_name=ax, num_experts=e,
-                         capacity_factor=args.capacity_factor)
+                         capacity_factor=args.capacity_factor,
+                         router_topk=args.router_topk)
         logits = y @ p["w_head"]
         logp = jax.nn.log_softmax(logits.astype(jnp.float32))
         ce = -jnp.mean(jnp.take_along_axis(logp, ys[:, None], 1))

@@ -163,8 +163,17 @@ def main():
         return [[int(t) for t in row if t not in (PAD, EOS)] for row in out]
 
     # val_pairs already holds the ragged (source, reversed-source) examples.
+    # Multi-controller: each process scores only its strided slice (plain
+    # lists are treated as LOCAL shards; the evaluator pools the counts),
+    # so BLEU is identical for any host count and nothing decodes P times.
+    if comm.inter_size > 1:
+        owned = [r for r in range(comm.size) if comm.owns_rank(r)]
+        local_pairs = [ex for i, ex in enumerate(val_pairs)
+                       if i % comm.size in owned]
+    else:
+        local_pairs = val_pairs
     bleu_eval = mn.bleu_evaluator(translate_fn, comm)
-    print(f"validation BLEU: {bleu_eval([val_pairs])['bleu']:.4f}")
+    print(f"validation BLEU: {bleu_eval([local_pairs])['bleu']:.4f}")
 
 
 if __name__ == "__main__":

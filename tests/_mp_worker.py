@@ -110,6 +110,31 @@ def main():
     orders = comm.allgather_obj(sync._order.tolist())
     assert all(o == orders[0] for o in orders), "unsynchronized orders"
 
+    # ---- evaluators: each process scores ONLY its owned shards, the
+    # combine restores the exact global metric (no P-fold double count) ----
+    data = list(range(10 * n))
+    scattered = mn.scatter_dataset(data, comm, shuffle=False)
+    calls = []
+
+    def ev(shard):
+        calls.append(len(shard))
+        vals = [shard[j] for j in range(len(shard))]
+        return {"mean": sum(vals) / len(vals)}
+
+    result = mn.create_multi_node_evaluator(ev, comm)(scattered)
+    want = sum(data) / len(data)
+    assert abs(result["mean"] - want) < 1e-9, (result, want)
+    # one call per OWNED rank, not per global rank
+    assert len(calls) == sum(1 for r in range(comm.size) if comm.owns_rank(r))
+    results = comm.allgather_obj(result["mean"])
+    assert all(abs(v - want) < 1e-9 for v in results), results
+
+    from chainermn_tpu.evaluators import bleu_evaluator
+
+    bleu = bleu_evaluator(lambda srcs: [list(s) for s in srcs], comm)(
+        [[([1, 2, 3, 4], [1, 2, 3, 4])]])  # identity: BLEU 1 on every gang size
+    assert abs(bleu["bleu"] - 1.0) < 1e-9, bleu
+
     # ---- checkpointer: per-process shards, gang-consistent resume ----
     from chainermn_tpu.extensions import create_multi_node_checkpointer
 
