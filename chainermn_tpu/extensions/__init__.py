@@ -1,7 +1,11 @@
 """Training-loop extensions (reference: ``chainermn/extensions/`` — SURVEY.md §2.6)."""
 
 from .allreduce_persistent import AllreducePersistent, allreduce_persistent  # noqa: F401
-from .checkpoint import MultiNodeCheckpointer, create_multi_node_checkpointer  # noqa: F401
+from .checkpoint import (  # noqa: F401
+    MultiNodeCheckpointer,
+    create_multi_node_checkpointer,
+    reshard_checkpoint,
+)
 from .observation_aggregator import (  # noqa: F401
     ObservationAggregator,
     aggregate_observations,
@@ -13,6 +17,7 @@ __all__ = [
     "allreduce_persistent",
     "MultiNodeCheckpointer",
     "create_multi_node_checkpointer",
+    "reshard_checkpoint",
     "ObservationAggregator",
     "aggregate_observations",
     "Watchdog",

@@ -36,6 +36,19 @@ import numpy as np
 from ..communicators.base import CommunicatorBase
 
 
+def _atomic_write(directory: str, target: str, payload: bytes) -> None:
+    """Write-then-rename so a crash mid-write never corrupts ``target``."""
+    fd, tmp = tempfile.mkstemp(dir=directory, prefix=".tmp_ckpt_")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(payload)
+        os.replace(tmp, target)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+
+
 def _to_host(tree):
     """Detach a pytree from devices: jax.Array → numpy on host."""
     return jax.tree_util.tree_map(
@@ -146,16 +159,7 @@ class MultiNodeCheckpointer:
         self._submit(self._write, payload, iteration)
 
     def _write(self, payload: bytes, iteration: int) -> None:
-        target = self._filename(iteration)
-        fd, tmp = tempfile.mkstemp(dir=self.path, prefix=".tmp_ckpt_")
-        try:
-            with os.fdopen(fd, "wb") as f:
-                f.write(payload)
-            os.replace(tmp, target)
-        except BaseException:
-            if os.path.exists(tmp):
-                os.unlink(tmp)
-            raise
+        _atomic_write(self.path, self._filename(iteration), payload)
         self._saves_since_gc += 1
         if self._saves_since_gc >= self.gc_interval:
             self._gc()
@@ -259,3 +263,57 @@ def create_multi_node_checkpointer(
         path = os.path.join(os.getcwd(), f"{name}-checkpoints")
     return MultiNodeCheckpointer(name, comm, path, cp_interval, gc_interval,
                                  keep, async_write)
+
+
+def reshard_checkpoint(path: str, name: str, new_nproc: int,
+                       iteration: Optional[int] = None,
+                       source_process: int = 0) -> int:
+    """Rewrite a checkpoint saved under one world size for another.
+
+    Beyond-reference (the reference — and :meth:`maybe_load` — REQUIRE the
+    original rank count): an offline tool for the common elastic case where
+    per-process state is REPLICATED (params, optimizer state, trainer
+    counters — everything the step builders keep replicated).  It takes
+    ``source_process``'s shard of the newest old-world generation (or
+    ``iteration``) and writes it as every one of the ``new_nproc`` shards.
+
+    Contract: rank-SPECIFIC state inside the shard (iterator cursors, RNG
+    per rank) is duplicated, not resharded — the multi-node iterator
+    tolerates this (non-master ranks install the master's broadcast state),
+    but anything else per-rank must be re-derived by the caller after
+    resume.  Run this offline (no gang needed), then restart the job at the
+    new world size.
+
+    Returns the iteration rewritten.  Raises if no complete old-world
+    generation exists.
+    """
+    pat = MultiNodeCheckpointer._PAT
+    by_gen: dict = {}
+    for fn in os.listdir(path):
+        m = pat.match(fn)
+        if m and m.group("name") == name:
+            key = (int(m.group("it")), int(m.group("nproc")))
+            by_gen.setdefault(key, set()).add(int(m.group("proc")))
+    if new_nproc < 1:
+        raise ValueError(f"new_nproc must be >= 1, got {new_nproc}")
+    # superset, not equality: a stray shard with proc >= nproc must not
+    # disqualify a generation whose required shards all exist
+    complete = [(it, nproc) for (it, nproc), procs in by_gen.items()
+                if procs >= set(range(nproc))
+                and (iteration is None or it == iteration)]
+    if not complete:
+        raise RuntimeError(
+            f"no complete generation for '{name}' in {path}"
+            + (f" at iteration {iteration}" if iteration is not None else ""))
+    it, old_nproc = max(complete)
+    if not 0 <= source_process < old_nproc:
+        raise ValueError(f"source_process {source_process} outside the old "
+                         f"world size {old_nproc}")
+    src = os.path.join(
+        path, f"{name}.iter{it:012d}.proc{source_process}of{old_nproc}")
+    with open(src, "rb") as f:
+        payload = f.read()
+    for p in range(new_nproc):
+        _atomic_write(path, os.path.join(
+            path, f"{name}.iter{it:012d}.proc{p}of{new_nproc}"), payload)
+    return it

@@ -305,3 +305,77 @@ class TestExceptHook:
         global_except_hook._global_except_hook(*info)  # must not os._exit
         global_except_hook.remove_hook()
         assert sys.excepthook is orig
+
+
+class TestReshardCheckpoint:
+    """Offline world-resize tool: a checkpoint saved at world size 2
+    becomes resumable at world size 1 (or any N) by duplicating the
+    replicated shard."""
+
+    def _write_shard(self, tmp_path, name, it, proc, nproc, state):
+        import pickle
+
+        fn = tmp_path / f"{name}.iter{it:012d}.proc{proc}of{nproc}"
+        fn.write_bytes(pickle.dumps(state))
+
+    def test_reshard_then_maybe_load(self, tmp_path):
+        from chainermn_tpu.extensions import (create_multi_node_checkpointer,
+                                              reshard_checkpoint)
+
+        # a 2-process world saved generations 5 and 9 (replicated payloads)
+        for it in (5, 9):
+            for p in range(2):
+                self._write_shard(tmp_path, "job", it, p, 2,
+                                  {"w": [1.0, 2.0], "iteration": it})
+        it = reshard_checkpoint(str(tmp_path), "job", new_nproc=1)
+        assert it == 9
+        # this process (world size 1) can now resume
+        comm = mn.create_communicator("xla")
+        cp = create_multi_node_checkpointer("job", comm, path=str(tmp_path))
+        loaded, resumed = cp.maybe_load({"w": None, "iteration": -1})
+        assert resumed == 9
+        assert loaded == {"w": [1.0, 2.0], "iteration": 9}
+        cp.finalize()
+
+    def test_picks_requested_iteration_and_source(self, tmp_path):
+        from chainermn_tpu.extensions import reshard_checkpoint
+
+        for p in range(2):
+            self._write_shard(tmp_path, "job", 5, p, 2, {"proc": p})
+        it = reshard_checkpoint(str(tmp_path), "job", new_nproc=3,
+                                iteration=5, source_process=1)
+        assert it == 5
+        import pickle
+        for p in range(3):
+            fn = tmp_path / f"job.iter{5:012d}.proc{p}of3"
+            assert pickle.loads(fn.read_bytes()) == {"proc": 1}
+
+    def test_incomplete_generation_rejected(self, tmp_path):
+        from chainermn_tpu.extensions import reshard_checkpoint
+
+        self._write_shard(tmp_path, "job", 5, 0, 2, {})  # proc 1 of 2 missing
+        with pytest.raises(RuntimeError, match="no complete generation"):
+            reshard_checkpoint(str(tmp_path), "job", new_nproc=1)
+
+    def test_bad_source_process_rejected(self, tmp_path):
+        from chainermn_tpu.extensions import reshard_checkpoint
+
+        for p in range(2):
+            self._write_shard(tmp_path, "job", 5, p, 2, {})
+        with pytest.raises(ValueError, match="source_process"):
+            reshard_checkpoint(str(tmp_path), "job", new_nproc=1,
+                               source_process=5)
+
+    def test_validates_new_nproc_and_ignores_stray_shards(self, tmp_path):
+        from chainermn_tpu.extensions import reshard_checkpoint
+
+        for p in range(2):
+            self._write_shard(tmp_path, "job", 5, p, 2, {"ok": True})
+        # stray out-of-range shard must not disqualify the generation
+        self._write_shard(tmp_path, "job", 5, 7, 2, {"stray": True})
+        with pytest.raises(ValueError, match="new_nproc"):
+            reshard_checkpoint(str(tmp_path), "job", new_nproc=0)
+        with pytest.raises(ValueError, match="source_process"):
+            reshard_checkpoint(str(tmp_path), "job", new_nproc=1,
+                               source_process=-1)
+        assert reshard_checkpoint(str(tmp_path), "job", new_nproc=1) == 5
