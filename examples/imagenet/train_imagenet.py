@@ -27,7 +27,8 @@ def main():
     parser.add_argument("--arch", default="resnet50",
                         choices=["resnet18", "resnet34", "resnet50",
                                  "resnet101", "resnet152",
-                                 "alex", "googlenet", "vgg16"])
+                                 "alex", "googlenet", "vgg16",
+                                 "vit_ti16", "vit_s16", "vit_b16"])
     parser.add_argument("--devices", type=int, default=0,
                         help="fake an N-device CPU mesh (0 = real chips)")
     parser.add_argument("--batchsize", type=int, default=64, help="per-chip batch")
@@ -53,6 +54,10 @@ def main():
                              "(reference: pure_nccl allreduce_grad_dtype; "
                              "int8 = quantized ring, beyond-reference)")
     parser.add_argument("--communicator", default="xla")
+    parser.add_argument("--fsdp", action="store_true",
+                        help="ZeRO-3: params, grads and optimizer state all "
+                             "sharded 1/P (BatchNorm-free archs only — use "
+                             "a ViT, e.g. --arch vit_s16)")
     args = parser.parse_args()
 
     if args.devices:
@@ -99,10 +104,18 @@ def main():
             optax.add_decayed_weights(args.weight_decay),
             optax.sgd(lr, momentum=args.momentum),
         )
-    optimizer = mn.create_multi_node_optimizer(
-        inner,
-        comm, double_buffering=args.double_buffering,
-        allreduce_grad_dtype=args.allreduce_grad_dtype)
+    if not args.fsdp:
+        optimizer = mn.create_multi_node_optimizer(
+            inner,
+            comm, double_buffering=args.double_buffering,
+            allreduce_grad_dtype=args.allreduce_grad_dtype)
+    elif args.allreduce_grad_dtype or args.double_buffering:
+        # These knobs live in the replicated-DP wrapper; silently dropping
+        # them would mislabel a benchmark run.
+        raise SystemExit(
+            "--fsdp handles gradient reduction itself (GSPMD "
+            "reduce-scatter); --allreduce-grad-dtype/--double-buffering "
+            "do not apply")
 
     def loss_and_metrics(logits, batch):
         _, labels = batch
@@ -110,11 +123,40 @@ def main():
         acc = (logits.argmax(-1) == labels).mean()
         return loss, {"accuracy": acc}
 
-    step = mn.make_flax_train_step(
-        model, loss_and_metrics, optimizer, mesh=mesh,
-        allreduce_grad_dtype=args.allreduce_grad_dtype)
-    variables = mn.replicate(dict(variables), mesh)
-    opt_state = mn.replicate(optimizer.init(variables["params"]), mesh)
+    if args.fsdp:
+        # ZeRO-3 path: GSPMD inserts per-use weight all-gathers and
+        # gradient reduce-scatters from the 1/P shardings alone.  BN's
+        # mutable running stats don't fit the pure-loss contract — the ViT
+        # archs (stat-free) are the fit.
+        from chainermn_tpu.parallel import (init_fsdp_params,
+                                            init_fsdp_state,
+                                            make_fsdp_train_step)
+
+        if "batch_stats" in variables:
+            raise SystemExit(
+                f"--fsdp needs a BatchNorm-free arch (got {args.arch}); "
+                f"try --arch vit_s16")
+
+        def fsdp_loss(p, batch):
+            logits = model.apply({"params": p}, batch[0], train=True)
+            loss, metrics = loss_and_metrics(logits, batch)
+            return loss, metrics
+
+        fsdp_params = init_fsdp_params(dict(variables)["params"], mesh)
+        opt_state = init_fsdp_state(inner, fsdp_params, mesh)
+        raw = make_fsdp_train_step(fsdp_loss, inner, mesh, has_aux=True)
+
+        def step(v, st, batch):
+            p, st, loss, metrics = raw(v["params"], st, batch)
+            return {"params": p}, st, loss, metrics
+
+        variables = {"params": fsdp_params}
+    else:
+        step = mn.make_flax_train_step(
+            model, loss_and_metrics, optimizer, mesh=mesh,
+            allreduce_grad_dtype=args.allreduce_grad_dtype)
+        variables = mn.replicate(dict(variables), mesh)
+        opt_state = mn.replicate(optimizer.init(variables["params"]), mesh)
 
     # Input pipeline: the native C++ prefetcher assembles batches in worker
     # threads (GIL-free) while the previous step computes — the reference's
