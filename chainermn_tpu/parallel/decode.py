@@ -33,26 +33,14 @@ from .tensor_parallel import row_parallel_dense
 from .transformer import _layer_norm, _project_qkv, apply_rope
 
 
-def lm_generate(params, prompt, rng: Optional[jax.Array] = None, *,
-                head_dim: int, axis_name: str,
-                max_new_tokens: int, temperature: float = 0.0):
-    """Generate ``max_new_tokens`` greedily (or sampled when
-    ``temperature > 0``) from ``prompt (B, S_p) int32``.
+def _decoder_core(params, head_dim: int, axis_name: str):
+    """Shared incremental-decoding machinery: ``(embed, attn_block, rope)``.
 
-    Call INSIDE ``shard_map`` with the model axis bound (use
-    :func:`make_lm_generator` for the jit face).  Returns ``(B,
-    max_new_tokens) int32``.
+    ``attn_block`` derives its batch from ``x`` so the same core serves the
+    greedy path (batch B) and beam search (batch B·K).
     """
-    b, s_p = prompt.shape
     d_model = params["embed"].shape[1]
     rope = "pos_embed" not in params
-    total = s_p + max_new_tokens
-    if not rope and total > params["pos_embed"].shape[0]:
-        raise ValueError(
-            f"prompt + max_new_tokens = {total} exceeds the learned "
-            f"pos_embed max_len {params['pos_embed'].shape[0]}; shorten the "
-            f"generation or init the model with pos_impl='rope'")
-    blocks = params["blocks"]
 
     def embed(tokens, positions):
         from .tensor_parallel import vocab_parallel_embedding
@@ -67,9 +55,10 @@ def lm_generate(params, prompt, rng: Optional[jax.Array] = None, *,
         return x
 
     def attn_block(x, blk, k_cache, v_cache, positions, write_at, q_valid):
-        """x (B,S,D) → block output; caches written at ``write_at + i`` for
+        """x (N,S,D) → block output; caches written at ``write_at + i`` for
         the i-th input position; query i attends cache [:q_valid + i + 1).
         """
+        n = x.shape[0]
         h = _layer_norm(x, blk["ln1_scale"], blk["ln1_bias"])
         q, k, v = _project_qkv(h, blk["attn"], head_dim, axis_name)
         if rope:
@@ -86,7 +75,7 @@ def lm_generate(params, prompt, rng: Optional[jax.Array] = None, *,
         # payoff): q heads regrouped onto their KV head — no per-tick
         # n_heads-sized cache copy.
         g = hl // hkv
-        q5 = q.reshape(b, s_q, hkv, g, head_dim)
+        q5 = q.reshape(n, s_q, hkv, g, head_dim)
         s = jnp.einsum("bqhgd,bkhd->bhgqk", q5, k_cache,
                        preferred_element_type=jnp.float32) / (head_dim ** 0.5)
         mask = jnp.arange(k_cache.shape[1])[None, None, None, None, :] < valid
@@ -95,13 +84,95 @@ def lm_generate(params, prompt, rng: Optional[jax.Array] = None, *,
         ctx = jnp.einsum("bhgqk,bkhd->bqhgd", p.astype(v_cache.dtype),
                          v_cache,
                          preferred_element_type=jnp.float32).astype(x.dtype)
-        ctx = ctx.reshape(b, s_q, -1)
+        ctx = ctx.reshape(n, s_q, -1)
         attn_out = row_parallel_dense(ctx, blk["attn"]["wo"],
                                       blk["attn"]["bo"], axis_name=axis_name)
         x = x + attn_out
         h = _layer_norm(x, blk["ln2_scale"], blk["ln2_bias"])
         from .tensor_parallel import tp_mlp
         return x + tp_mlp(h, blk["mlp"], axis_name=axis_name), k_cache, v_cache
+
+    return embed, attn_block, rope
+
+
+def _check_length(params, total: int, rope: bool) -> None:
+    if not rope and total > params["pos_embed"].shape[0]:
+        raise ValueError(
+            f"prompt + max_new_tokens = {total} exceeds the learned "
+            f"pos_embed max_len {params['pos_embed'].shape[0]}; shorten the "
+            f"generation or init the model with pos_impl='rope'")
+
+
+def _kv_heads(params, head_dim: int) -> int:
+    a = params["blocks"][0]["attn"]
+    return (a["wkv"].shape[1] // (2 * head_dim) if "wkv" in a
+            else a["bqkv"].shape[0] // (3 * head_dim))
+
+
+def _prefill(params, embed, attn_block, prompt, total: int, head_dim: int):
+    """Run the full prompt through the stack, returning ``(h_final,
+    caches)`` with per-layer KV caches of length ``total`` (prompt written,
+    tail zeros)."""
+    b, s_p = prompt.shape
+    n_kv = _kv_heads(params, head_dim)
+    positions = jnp.arange(s_p)
+    x = embed(prompt, positions)
+    caches = []
+    for blk in params["blocks"]:
+        k0 = jnp.zeros((b, total, n_kv, head_dim), x.dtype)
+        v0 = jnp.zeros((b, total, n_kv, head_dim), x.dtype)
+        x, kc, vc = attn_block(x, blk, k0, v0, positions, 0, 0)
+        caches.append((kc, vc))
+    return _layer_norm(x, params["lnf_scale"], params["lnf_bias"]), caches
+
+
+def _make_face(mesh: Optional[Mesh], axis_name: str, inner, has_rng: bool):
+    """Shared jit face for the generators: resolve the mesh, cache one
+    compiled shard_map program per param STRUCTURE, device_put per spec."""
+    from jax import shard_map
+
+    from .transformer import transformer_lm_specs
+
+    if mesh is None:
+        from ..topology import make_mesh
+        mesh = make_mesh(axis_name=axis_name)
+
+    cache = {}
+
+    def apply(params, prompt, rng=None):
+        specs = transformer_lm_specs(params, axis_name)
+        key = jax.tree_util.tree_structure(specs)
+        if key not in cache:
+            in_specs = (specs, P(), P()) if has_rng else (specs, P())
+            cache[key] = jax.jit(shard_map(
+                inner, mesh=mesh, in_specs=in_specs, out_specs=P()))
+        sharded = jax.tree_util.tree_map(
+            lambda x, sp: jax.device_put(x, NamedSharding(mesh, sp)),
+            params, specs)
+        if has_rng:
+            if rng is None:
+                rng = jax.random.PRNGKey(0)
+            return cache[key](sharded, prompt, rng)
+        return cache[key](sharded, prompt)
+
+    return apply
+
+
+def lm_generate(params, prompt, rng: Optional[jax.Array] = None, *,
+                head_dim: int, axis_name: str,
+                max_new_tokens: int, temperature: float = 0.0):
+    """Generate ``max_new_tokens`` greedily (or sampled when
+    ``temperature > 0``) from ``prompt (B, S_p) int32``.
+
+    Call INSIDE ``shard_map`` with the model axis bound (use
+    :func:`make_lm_generator` for the jit face).  Returns ``(B,
+    max_new_tokens) int32``.
+    """
+    b, s_p = prompt.shape
+    total = s_p + max_new_tokens
+    embed, attn_block, rope = _decoder_core(params, head_dim, axis_name)
+    _check_length(params, total, rope)
+    blocks = params["blocks"]
 
     def logits_next(h_last, step_pos):
         """Vocab-parallel next-token choice from ``h_last (B, D)``;
@@ -134,18 +205,7 @@ def lm_generate(params, prompt, rng: Optional[jax.Array] = None, *,
             jnp.where(winner, local_idx, jnp.int32(2 ** 30)), axis_name)
 
     # ---- prefill: full prompt through the stack, caches written ----
-    n_kv = (blocks[0]["attn"]["wkv"].shape[1] // (2 * head_dim)
-            if "wkv" in blocks[0]["attn"]
-            else blocks[0]["attn"]["bqkv"].shape[0] // (3 * head_dim))
-    positions = jnp.arange(s_p)
-    x = embed(prompt, positions)
-    caches = []
-    for blk in blocks:
-        k0 = jnp.zeros((b, total, n_kv, head_dim), x.dtype)
-        v0 = jnp.zeros((b, total, n_kv, head_dim), x.dtype)
-        x, kc, vc = attn_block(x, blk, k0, v0, positions, 0, 0)
-        caches.append((kc, vc))
-    h = _layer_norm(x, params["lnf_scale"], params["lnf_bias"])
+    h, caches = _prefill(params, embed, attn_block, prompt, total, head_dim)
     first = logits_next(h[:, -1], jnp.int32(s_p))
 
     # ---- decode: one token per scan tick ----
@@ -169,38 +229,122 @@ def lm_generate(params, prompt, rng: Optional[jax.Array] = None, *,
     return out.astype(jnp.int32)
 
 
+def lm_generate_beam(params, prompt, *, head_dim: int, axis_name: str,
+                     max_new_tokens: int, beam_size: int):
+    """Beam search with the KV cache: the highest-cumulative-log-prob
+    continuation of each prompt among ``beam_size`` beams.
+
+    Fixed-length beams (the toy LMs here have no EOS semantics); exact
+    under the cumulative-log-prob objective because each beam contributes
+    its top-``beam_size`` tokens and the global top-``beam_size`` of
+    ``K·K`` candidates can never need a token outside a beam's own top-K.
+    TP-composed: per-shard top-K of the vocab-sharded log-probs, one small
+    all_gather of ``K`` candidates per shard, replicated merge.  Returns
+    ``(B, max_new_tokens) int32`` — the best beam.
+    """
+    b, s_p = prompt.shape
+    k = beam_size
+    total = s_p + max_new_tokens
+    embed, attn_block, rope = _decoder_core(params, head_dim, axis_name)
+    _check_length(params, total, rope)
+    blocks = params["blocks"]
+
+    def shard_logprobs(h_last):
+        """(N, D) → local log-probs (N, V/P) + this shard's vocab offset.
+        Normalized GLOBALLY (pmax/psum logsumexp across shards)."""
+        table = params["embed"]
+        logits = jnp.einsum("bd,vd->bv", h_last, table,
+                            preferred_element_type=jnp.float32)
+        m = jax.lax.pmax(logits.max(-1), axis_name)              # (N,)
+        z = jax.lax.psum(jnp.exp(logits - m[:, None]).sum(-1), axis_name)
+        logz = m + jnp.log(z)
+        start = jax.lax.axis_index(axis_name) * table.shape[0]
+        return logits - logz[:, None], start
+
+    def global_topk(h_last):
+        """(N, D) → (values (N, K), token_ids (N, K)) — global top-K over
+        the sharded vocab; invariant outputs (pmax over value-identical
+        gathers fixes the VMA type at zero numeric cost)."""
+        logp, start = shard_logprobs(h_last)
+        v_loc, i_loc = jax.lax.top_k(logp, k)                    # (N, K)
+        i_loc = i_loc + start
+        gv = jax.lax.all_gather(v_loc, axis_name, axis=1, tiled=True)
+        gi = jax.lax.all_gather(i_loc, axis_name, axis=1, tiled=True)
+        gv = jax.lax.pmax(gv, axis_name)   # identical values; type → invariant
+        gi = jax.lax.pmax(gi, axis_name)
+        v, pos = jax.lax.top_k(gv, k)                            # (N, K)
+        ids = jnp.take_along_axis(gi, pos, axis=1)
+        return v, ids
+
+    # ---- prefill once at batch B, then tile caches to B·K ----
+    h, caches = _prefill(params, embed, attn_block, prompt, total, head_dim)
+    caches = [(jnp.repeat(kc, k, axis=0), jnp.repeat(vc, k, axis=0))
+              for kc, vc in caches]
+    v0k, i0k = global_topk(h[:, -1])                             # (B, K)
+    scores = v0k                                                 # (B, K)
+    tokens = i0k.astype(jnp.int32)                               # live beams
+    toks_buf = jnp.zeros((b, k, max_new_tokens), jnp.int32)
+    toks_buf = toks_buf.at[:, :, 0].set(tokens)
+
+    def tick(carry, i):
+        tokens, scores, toks_buf, caches = carry
+        pos = s_p + i - 1
+        x = embed(tokens.reshape(b * k)[:, None], pos[None])     # (B·K, 1, D)
+        new_caches = []
+        for blk, (kc, vc) in zip(blocks, caches):
+            x, kc, vc = attn_block(x, blk, kc, vc, pos[None], pos, pos)
+            new_caches.append((kc, vc))
+        h = _layer_norm(x, params["lnf_scale"], params["lnf_bias"])
+        v_k, i_k = global_topk(h[:, -1])                         # (B·K, K)
+        cand = scores[:, :, None] + v_k.reshape(b, k, k)         # (B, K, K)
+        flat = cand.reshape(b, k * k)
+        scores, pos_flat = jax.lax.top_k(flat, k)                # (B, K)
+        parent = pos_flat // k                                   # (B, K)
+        tokens = jnp.take_along_axis(
+            i_k.reshape(b, k, k).reshape(b, k * k), pos_flat, axis=1
+        ).astype(jnp.int32)
+        # Reindex histories and caches by the winning parents.
+        toks_buf = jnp.take_along_axis(toks_buf, parent[:, :, None], axis=1)
+        toks_buf = toks_buf.at[:, :, i].set(tokens)
+        reind = []
+        for kc, vc in new_caches:
+            shp = kc.shape  # (B·K, total, hkv, hd)
+            kc = jnp.take_along_axis(
+                kc.reshape((b, k) + shp[1:]),
+                parent[:, :, None, None, None], axis=1).reshape(shp)
+            vc = jnp.take_along_axis(
+                vc.reshape((b, k) + shp[1:]),
+                parent[:, :, None, None, None], axis=1).reshape(shp)
+            reind.append((kc, vc))
+        return (tokens, scores, toks_buf, reind), None
+
+    if max_new_tokens > 1:
+        (tokens, scores, toks_buf, _), _ = jax.lax.scan(
+            tick, (tokens, scores, toks_buf, caches),
+            jnp.arange(1, max_new_tokens))
+    # top_k keeps beams score-sorted, so beam 0 is the winner by invariant.
+    return toks_buf[:, 0].astype(jnp.int32)
+
+
+def make_lm_beam_generator(mesh: Optional[Mesh] = None,
+                           axis_name: str = "model", *, head_dim: int,
+                           max_new_tokens: int, beam_size: int):
+    """Eager/jit face of :func:`lm_generate_beam`: ``fn(params, prompt) ->
+    (B, max_new) tokens`` over TP-sharded global params."""
+    return _make_face(
+        mesh, axis_name,
+        partial(lm_generate_beam, head_dim=head_dim, axis_name=axis_name,
+                max_new_tokens=max_new_tokens, beam_size=beam_size),
+        has_rng=False)
+
+
 def make_lm_generator(mesh: Optional[Mesh] = None, axis_name: str = "model",
                       *, head_dim: int, max_new_tokens: int,
                       temperature: float = 0.0):
     """Eager/jit face: ``fn(params, prompt[, rng]) -> (B, max_new) tokens``
     over TP-sharded global params (``transformer_lm_specs`` layout)."""
-    from jax import shard_map
-
-    from .transformer import transformer_lm_specs
-
-    if mesh is None:
-        from ..topology import make_mesh
-        mesh = make_mesh(axis_name=axis_name)
-
-    cache = {}  # one compiled program per param STRUCTURE (spec pytree)
-
-    def apply(params, prompt, rng=None):
-        specs = transformer_lm_specs(params, axis_name)
-        if rng is None:
-            rng = jax.random.PRNGKey(0)
-        key = jax.tree_util.tree_structure(specs)
-        if key not in cache:
-            cache[key] = jax.jit(shard_map(
-                partial(lm_generate, head_dim=head_dim, axis_name=axis_name,
-                        max_new_tokens=max_new_tokens,
-                        temperature=temperature),
-                mesh=mesh,
-                in_specs=(specs, P(), P()),
-                out_specs=P(),
-            ))
-        sharded = jax.tree_util.tree_map(
-            lambda x, sp: jax.device_put(x, NamedSharding(mesh, sp)),
-            params, specs)
-        return cache[key](sharded, prompt, rng)
-
-    return apply
+    return _make_face(
+        mesh, axis_name,
+        partial(lm_generate, head_dim=head_dim, axis_name=axis_name,
+                max_new_tokens=max_new_tokens, temperature=temperature),
+        has_rng=True)

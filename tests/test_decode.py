@@ -29,34 +29,35 @@ HEAD_DIM = D // HEADS
 B, S_P, NEW = 2, 6, 5
 
 
+def _forward_logits(p, tokens):
+    """Reference forward: per-position logits ``(B, S, V)`` from the public
+    training-path pieces — the ONE oracle both the greedy and beam tests
+    score against."""
+    from chainermn_tpu.parallel.tensor_parallel import (
+        vocab_parallel_embedding)
+    from chainermn_tpu.parallel.transformer import _layer_norm, tp_block
+
+    x = vocab_parallel_embedding(tokens, p["embed"], axis_name="model")
+    x = x * (p["embed"].shape[1] ** 0.5)
+    positions = None
+    if "pos_embed" in p:
+        x = x + p["pos_embed"][: x.shape[1]][None]
+    else:
+        positions = jnp.arange(x.shape[1])
+    for blk in p["blocks"]:
+        x = tp_block(x, blk, head_dim=HEAD_DIM, axis_name="model",
+                     positions=positions)
+    x = _layer_norm(x, p["lnf_scale"], p["lnf_bias"])
+    return jnp.einsum("bsd,vd->bsv", x, p["embed"],
+                      preferred_element_type=jnp.float32)
+
+
 def _full_forward_argmax_oracle(params, prompt, new_tokens, devices):
     """Greedy reference: re-run the FULL sequence each step on a 1-device
     model-axis mesh and take the last position's argmax."""
     mesh = mn.make_nd_mesh(("data", "model"), (1, 1), devices[:1])
-
-    def last_logits(p, tokens):
-        # reuse the training loss machinery's forward by asking for the
-        # loss of a dummy target and reading back... simpler: recompute
-        # the stack inline via the public pieces.
-        from chainermn_tpu.parallel.tensor_parallel import (
-            vocab_parallel_embedding)
-        from chainermn_tpu.parallel.transformer import _layer_norm, tp_block
-
-        x = vocab_parallel_embedding(tokens, p["embed"], axis_name="model")
-        x = x * (p["embed"].shape[1] ** 0.5)
-        positions = None
-        if "pos_embed" in p:
-            x = x + p["pos_embed"][: x.shape[1]][None]
-        else:
-            positions = jnp.arange(x.shape[1])
-        for blk in p["blocks"]:
-            x = tp_block(x, blk, head_dim=HEAD_DIM, axis_name="model",
-                         positions=positions)
-        x = _layer_norm(x, p["lnf_scale"], p["lnf_bias"])
-        return jnp.einsum("bd,vd->bv", x[:, -1], p["embed"],
-                          preferred_element_type=jnp.float32)
-
-    fn = shard_map(last_logits, mesh=mesh, in_specs=(P(), P()), out_specs=P())
+    fn = shard_map(lambda p, t: _forward_logits(p, t)[:, -1],
+                   mesh=mesh, in_specs=(P(), P()), out_specs=P())
     seq = prompt
     out = []
     for _ in range(new_tokens):
@@ -138,3 +139,79 @@ def test_sampling_noise_is_fresh_per_step(devices):
                             max_new_tokens=8, temperature=5.0)
     out = np.asarray(gen(params, prompt, jax.random.PRNGKey(0)))[0]
     assert len(set(out.tolist())) > 1, out
+
+
+class TestBeamSearch:
+    """Beam search over the KV cache: beam_size=1 must equal greedy
+    exactly; larger beams must never score below greedy under the
+    cumulative-log-prob objective; TP width must not change the tokens."""
+
+    def _make(self, pos_impl="learned", n_kv_heads=None, seed=5):
+        return init_tp_transformer_lm(
+            jax.random.PRNGKey(seed), VOCAB, D, HEADS, LAYERS, max_len=SEQ,
+            pos_impl=pos_impl, n_kv_heads=n_kv_heads)
+
+    def _seq_logprob(self, params, prompt, continuation, devices):
+        """Score a continuation by full re-forward (the objective beam
+        search maximizes)."""
+        mesh = mn.make_nd_mesh(("data", "model"), (1, 1), devices[:1])
+        full = np.concatenate([prompt, continuation], axis=1)
+
+        def lp(p, tokens):
+            logp = jax.nn.log_softmax(
+                _forward_logits(p, tokens[:, :-1]), axis=-1)
+            picked = jnp.take_along_axis(
+                logp, tokens[:, 1:, None], axis=-1)[..., 0]
+            # only the continuation positions count
+            return picked[:, -continuation.shape[1]:].sum(-1)
+
+        fn = shard_map(lp, mesh=mesh, in_specs=(P(), P()), out_specs=P())
+        return np.asarray(jax.jit(fn)(params, full))
+
+    @pytest.mark.parametrize("pos_impl", ["learned", "rope"])
+    def test_beam1_equals_greedy(self, devices, pos_impl):
+        from chainermn_tpu.parallel import make_lm_beam_generator
+
+        params = self._make(pos_impl=pos_impl)
+        prompt = np.random.RandomState(5).randint(
+            0, VOCAB, (B, S_P)).astype(np.int32)
+        mesh = mn.make_nd_mesh(("data", "model"), (1, 2), devices[:2])
+        greedy = make_lm_generator(mesh, "model", head_dim=HEAD_DIM,
+                                   max_new_tokens=NEW)
+        beam1 = make_lm_beam_generator(mesh, "model", head_dim=HEAD_DIM,
+                                       max_new_tokens=NEW, beam_size=1)
+        np.testing.assert_array_equal(np.asarray(beam1(params, prompt)),
+                                      np.asarray(greedy(params, prompt)))
+
+    @pytest.mark.parametrize("n_kv_heads", [None, 2])
+    def test_beam_never_scores_below_greedy(self, devices, n_kv_heads):
+        from chainermn_tpu.parallel import make_lm_beam_generator
+
+        params = self._make(seed=6, n_kv_heads=n_kv_heads)
+        prompt = np.random.RandomState(6).randint(
+            0, VOCAB, (B, S_P)).astype(np.int32)
+        mesh = mn.make_nd_mesh(("data", "model"), (1, 2), devices[:2])
+        greedy = np.asarray(make_lm_generator(
+            mesh, "model", head_dim=HEAD_DIM, max_new_tokens=NEW)(
+            params, prompt))
+        beam = np.asarray(make_lm_beam_generator(
+            mesh, "model", head_dim=HEAD_DIM, max_new_tokens=NEW,
+            beam_size=4)(params, prompt))
+        lp_g = self._seq_logprob(params, prompt, greedy, devices)
+        lp_b = self._seq_logprob(params, prompt, beam, devices)
+        assert (lp_b >= lp_g - 1e-4).all(), (lp_b, lp_g)
+
+    def test_tp_width_invariant(self, devices):
+        from chainermn_tpu.parallel import make_lm_beam_generator
+
+        params = self._make(seed=7)
+        prompt = np.random.RandomState(7).randint(
+            0, VOCAB, (B, S_P)).astype(np.int32)
+        outs = {}
+        for tp in (1, 2, 4):
+            mesh = mn.make_nd_mesh(("data", "model"), (1, tp), devices[:tp])
+            gen = make_lm_beam_generator(mesh, "model", head_dim=HEAD_DIM,
+                                         max_new_tokens=NEW, beam_size=3)
+            outs[tp] = np.asarray(gen(params, prompt))
+        np.testing.assert_array_equal(outs[1], outs[2])
+        np.testing.assert_array_equal(outs[1], outs[4])
