@@ -33,6 +33,7 @@ class _EncoderStep(nn.Module):
 
     hidden: int
     n_layers: int
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, carry, xs):
@@ -40,7 +41,11 @@ class _EncoderStep(nn.Module):
         new_carry = []
         inp = x
         for i in range(self.n_layers):
-            cell = nn.OptimizedLSTMCell(self.hidden, name=f"lstm{i}")
+            # dtype must be EXPLICIT: the default (None) promotes bf16
+            # inputs with fp32 params to an fp32 carry, which breaks the
+            # scan carry-type contract against the bf16 initial carry.
+            cell = nn.OptimizedLSTMCell(self.hidden, dtype=self.dtype,
+                                        name=f"lstm{i}")
             (c_new, h_new), inp = cell(carry[i], inp)
             c_old, h_old = carry[i]
             new_carry.append((m * c_new + (1 - m) * c_old,
@@ -54,13 +59,15 @@ class _DecoderStep(nn.Module):
 
     hidden: int
     n_layers: int
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, carry, x):
         new_carry = []
         inp = x
         for i in range(self.n_layers):
-            cell = nn.OptimizedLSTMCell(self.hidden, name=f"lstm{i}")
+            cell = nn.OptimizedLSTMCell(self.hidden, dtype=self.dtype,
+                                        name=f"lstm{i}")
             c, inp = cell(carry[i], inp)
             new_carry.append(c)
         return tuple(new_carry), inp
@@ -93,9 +100,11 @@ class Seq2seq(nn.Module):
         self.embed_y = nn.Embed(self.n_target_vocab, self.n_units,
                                 dtype=self.dtype)
         self.encoder = _scan_over_time(
-            _EncoderStep, self.n_units, self.n_layers, name="encoder")
+            _EncoderStep, self.n_units, self.n_layers, self.dtype,
+            name="encoder")
         self.decoder = _scan_over_time(
-            _DecoderStep, self.n_units, self.n_layers, name="decoder")
+            _DecoderStep, self.n_units, self.n_layers, self.dtype,
+            name="decoder")
         self.proj = nn.Dense(self.n_target_vocab, dtype=self.dtype)
 
     def _init_carry_like(self, emb: jnp.ndarray):

@@ -140,3 +140,23 @@ class TestSeq2seqTrains:
             for r in range(comm.size) for i in range(lens[r]))
         expect = sorted((tuple(s), tuple(t)) for s, t in pairs)
         assert seen == expect
+
+
+def test_bf16_dtype_traces_and_trains():
+    """Regression: the TPU configuration (dtype=bfloat16) must trace — an
+    LSTM cell built without an explicit dtype promotes the bf16 carry to
+    fp32 and breaks the scan carry contract (only surfaced on-chip, where
+    the example selects bf16)."""
+    import jax
+    import jax.numpy as jnp
+
+    model = Seq2seq(10, 10, n_units=16, n_layers=2, dtype=jnp.bfloat16)
+    src = np.array([[4, 5, 6, 0], [7, 8, 0, 0]], np.int32)
+    tin = np.array([[1, 6, 5, 4], [1, 8, 7, 0]], np.int32)
+    params = model.init(jax.random.PRNGKey(0), src, tin)
+    logits = model.apply(params, src, tin)
+    assert logits.dtype == jnp.float32  # head stays fp32
+    assert bool(jnp.isfinite(logits).all())
+    g = jax.grad(lambda p: (model.apply(p, src, tin) ** 2).mean())(params)
+    assert all(bool(jnp.isfinite(l).all())
+               for l in jax.tree_util.tree_leaves(g))
