@@ -50,6 +50,11 @@ def main():
     comm = mn.create_communicator("xla")
     mesh = comm.mesh
     print(f"chips: {comm.size}")
+    if comm.size < 2:
+        raise SystemExit(
+            "model parallelism needs at least 2 ranks to place stages on; "
+            "run with --devices 2 (or more) to fake a multi-chip mesh on "
+            "one host")
 
     rng = np.random.RandomState(0)
     xs = rng.randn(64, 16).astype(np.float32)
