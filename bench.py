@@ -192,10 +192,14 @@ def bench_transformer_lm(n_chips_hint=None):
     batch = (jax.device_put(tokens, NamedSharding(mesh, P("data"))),)
 
     step_c, flops_per_step = compile_with_flops(step, p, st, batch)
-    dt, _ = measure(step_c, p, st, batch, steps=10)
+    # 40 steps per host readback: the axon tunnel's readback costs ~100ms
+    # flat (measured), so few-step loops inflate per-step time by ~10ms.
+    steps = 40
+    dt, _ = measure(step_c, p, st, batch, steps=steps)
     toks = per_chip_batch * seq  # per chip per step
-    tps = 10 * toks / dt  # measure() already covers all chips' shards: dt is
-    # wall-clock for the whole mesh, so per-chip tokens/sec uses per-chip toks
+    tps = steps * toks / dt  # measure() already covers all chips' shards: dt
+    # is wall-clock for the whole mesh, so per-chip tokens/sec uses per-chip
+    # toks
     n_params = sum(np.prod(l.shape) for l in jax.tree_util.tree_leaves(params))
     flops_source = "compiled"
     # Per-chip convention throughout, same as the ResNet path: GSPMD
@@ -207,7 +211,7 @@ def bench_transformer_lm(n_chips_hint=None):
         flops_source = "analytic"
     dev = jax.devices()[0]
     peak = peak_flops_for(dev.device_kind)
-    mfu = flops_per_step * 10 / dt / peak if peak else None
+    mfu = flops_per_step * steps / dt / peak if peak else None
     suspect = bool(mfu and mfu > 1.0)
     if suspect:
         print(f"bench: WARNING transformer MFU {mfu:.2f} > 1.0 impossible — "
@@ -288,7 +292,9 @@ def main():
     on_tpu = dev.platform == "tpu"
     per_chip_batch = 128 if on_tpu else 8
     image_size = 224 if on_tpu else 32
-    steps = 20 if on_tpu else 2
+    # 40 steps per host readback on TPU: the axon tunnel's readback costs
+    # ~100ms flat (measured), so short loops overstate per-step time.
+    steps = 40 if on_tpu else 2
 
     step, variables, opt_state, batch, n_chips, global_batch = build_step(
         "resnet50", image_size, per_chip_batch, args.allreduce_grad_dtype)
@@ -354,8 +360,9 @@ def main():
             try:
                 s2, v2, o2, ba2, nc2, gb2 = build_step(
                     "resnet50", image_size, b, args.allreduce_grad_dtype)
-                d2, _ = measure(s2, v2, o2, ba2, steps=10)
-                ips_b = 10 * gb2 / d2 / nc2
+                sweep_steps = max(10, 30 * 128 // b)  # ≥1.5s per timing loop
+                d2, _ = measure(s2, v2, o2, ba2, steps=sweep_steps)
+                ips_b = sweep_steps * gb2 / d2 / nc2
                 batch_sweep[str(b)] = {"ips": round(ips_b, 2),
                                        "mfu": mfu_of(ips_b)}
             except Exception as e:

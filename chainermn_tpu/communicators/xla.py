@@ -94,6 +94,13 @@ class XlaCommunicator(CommunicatorBase):
     def owns_rank(self, r: int) -> bool:
         return self._devices[r].process_index == jax.process_index()
 
+    def _ranks_by_process(self) -> Dict[int, List[int]]:
+        """process_index → its ranks in mesh order (gather/scatter routing)."""
+        ranks_of: Dict[int, List[int]] = {}
+        for r, d in enumerate(self._devices):
+            ranks_of.setdefault(d.process_index, []).append(r)
+        return ranks_of
+
     def device_of(self, rank: int):
         return self._devices[rank]
 
@@ -156,21 +163,33 @@ class XlaCommunicator(CommunicatorBase):
         the payload is meaningful only at root; other ranks receive None.
         Single-controller (one process owns every rank): the stack already
         IS the gathered array — returned directly.  Multi-controller: each
-        process contributes its local rows over DCN
-        (``process_allgather``); the root-owning process returns the
-        assembled host array and every other process returns None — the
-        payload physically lands on root's host, which the old rank-major
-        identity never delivered.
+        non-root process sends ONLY its local rows to root over the
+        KV-store lane (the exact mirror of :meth:`scatter` — a
+        ``process_allgather`` would land the full stack on EVERY host,
+        moving P× the needed bytes over DCN); root assembles the stack in
+        rank order and returns it, every other process returns None.
         """
         x = self._check(jnp.asarray(x))
         if not self._multiprocess():
             return x
-        from jax.experimental import multihost_utils
-        # process_allgather on the GLOBAL array reassembles by each shard's
-        # global index (verified under a real 2-process gang), so arbitrary
-        # rank→process interleavings come back in rank order.
-        full = np.asarray(multihost_utils.process_allgather(x, tiled=True))
-        return full if self.owns_rank(root) else None
+        me = jax.process_index()
+        ranks_of = self._ranks_by_process()
+        # x is the rank-major global stack; each process can address only
+        # its own shards, so pull the local rows out via addressable data.
+        local = {}
+        for shard in x.addressable_shards:
+            r = shard.index[0].start if isinstance(shard.index, tuple) else 0
+            local[r if r is not None else 0] = np.asarray(shard.data)
+        if self.owns_rank(root):
+            rows = dict(local)
+            for proc, ranks in ranks_of.items():
+                if proc == me:
+                    continue
+                payload = self.recv_obj(source=ranks[0])
+                rows.update(payload)
+            return np.concatenate([rows[r] for r in sorted(rows)], axis=0)
+        self.send_obj(local, dest=root)
+        return None
 
     def allgather(self, x):
         x = self._check(jnp.asarray(x))
@@ -206,9 +225,7 @@ class XlaCommunicator(CommunicatorBase):
         from jax.experimental import multihost_utils
 
         me = jax.process_index()
-        ranks_of = {}
-        for r, d in enumerate(self._devices):
-            ranks_of.setdefault(d.process_index, []).append(r)
+        ranks_of = self._ranks_by_process()
         if self.owns_rank(root):
             x = np.asarray(x)
             self._check_leading(x)

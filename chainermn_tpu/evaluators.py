@@ -31,9 +31,13 @@ def _as_shards(scattered, communicator) -> Sequence:
         if communicator.inter_size > 1:
             owned = [r for r in range(min(len(scattered), communicator.size))
                      if communicator.owns_rank(r)]
-            if owned:
-                return [scattered.shard(r) for r in owned]
-            return [scattered.local()]
+            # owned may be empty when len(scattered) < communicator.size
+            # (more processes than shards): contribute NOTHING rather than
+            # re-evaluating another process's shard — the allreduce_obj
+            # combine tolerates zero local shards, and a fallback to
+            # ``scattered.local()`` would double-count that shard's
+            # statistics (its owner evaluates it too).
+            return [scattered.shard(r) for r in owned]
         return [scattered.shard(r) for r in range(len(scattered))]
     return list(scattered)
 
@@ -62,10 +66,17 @@ def create_multi_node_evaluator(actual_evaluator: Callable, communicator: Commun
         # global mean stays example-weighted even when hosts hold unequal
         # shard counts.  Identity single-process (all shards local).
         if communicator.inter_size > 1:
+            # Union of keys with (0, 0) identity: a process that owns no
+            # shard (more processes than shards) contributes an empty dict
+            # and must not erase everyone else's metrics.
+            def combine(a, b):
+                zero = (0.0, 0.0)
+                return {k: (a.get(k, zero)[0] + b.get(k, zero)[0],
+                            a.get(k, zero)[1] + b.get(k, zero)[1])
+                        for k in set(a) | set(b)}
+
             summed = communicator.allreduce_obj(
-                {k: (totals[k], weights[k]) for k in totals},
-                op=lambda a, b: {k: (a[k][0] + b[k][0], a[k][1] + b[k][1]) for k in a},
-            )
+                {k: (totals[k], weights[k]) for k in totals}, op=combine)
             return {k: s / w for k, (s, w) in summed.items()}
         return {k: totals[k] / weights[k] for k in totals}
 

@@ -305,7 +305,17 @@ def reshard_checkpoint(path: str, name: str, new_nproc: int,
         raise RuntimeError(
             f"no complete generation for '{name}' in {path}"
             + (f" at iteration {iteration}" if iteration is not None else ""))
-    it, old_nproc = max(complete)
+    it = max(i for i, _ in complete)
+    worlds = sorted(n for i, n in complete if i == it)
+    if len(worlds) > 1 and iteration is None:
+        # Two complete generations at the SAME iteration under different
+        # world sizes: picking one silently decides which payload wins.
+        # Make the caller choose via iteration= + cleaning the stale set.
+        raise RuntimeError(
+            f"iteration {it} of '{name}' has complete checkpoints for "
+            f"multiple world sizes {worlds}; remove the stale generation "
+            f"or pass iteration= explicitly to confirm the newest one")
+    old_nproc = worlds[-1]
     if not 0 <= source_process < old_nproc:
         raise ValueError(f"source_process {source_process} outside the old "
                          f"world size {old_nproc}")

@@ -32,7 +32,7 @@ class _MHSA(nn.Module):
 
     num_heads: int
     dtype: Any = jnp.bfloat16
-    attn_impl: str = "xla"
+    attn_impl: str = "auto"
 
     @nn.compact
     def __call__(self, x):
@@ -40,7 +40,9 @@ class _MHSA(nn.Module):
         h = self.num_heads
         qkv = nn.DenseGeneral((3, h, d // h), dtype=self.dtype, name="qkv")(x)
         q, k, v = (qkv[:, :, i] for i in range(3))  # each (B, S, H, Dh)
-        if self.attn_impl == "flash":
+        from ..ops.flash_attention import resolve_attn_impl
+
+        if resolve_attn_impl(self.attn_impl, s) == "flash":
             from ..ops import flash_attention
 
             o = flash_attention(q, k, v)
@@ -57,7 +59,7 @@ class _Block(nn.Module):
     num_heads: int
     mlp_ratio: int = 4
     dtype: Any = jnp.bfloat16
-    attn_impl: str = "xla"
+    attn_impl: str = "auto"
 
     @nn.compact
     def __call__(self, x):
@@ -79,7 +81,7 @@ class ViT(nn.Module):
     depth: int = 12
     num_heads: int = 6
     dtype: Any = jnp.bfloat16
-    attn_impl: str = "xla"
+    attn_impl: str = "auto"
     stem_strides: int = 2  # accepted for zoo-interface parity; unused
 
     @nn.compact

@@ -133,6 +133,11 @@ def zero1_specs(params, mesh: Mesh, axis_name: Optional[str] = None):
 
     Beyond-reference (the reference replicated optimizer state on every
     rank): with ``P`` data-parallel chips, Adam's m/v live ``1/P`` per chip.
+
+    .. note:: breaking default change (round 2): ``axis_name`` defaults to
+       ``None`` — resolved to the mesh's only axis, raising on multi-axis
+       meshes instead of silently assuming ``'data'``.  Callers on N-D
+       meshes must name the axis explicitly.
     """
     axis_name = _data_axis(mesh, axis_name)
     n = mesh.shape[axis_name]

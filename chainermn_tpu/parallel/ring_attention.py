@@ -107,7 +107,7 @@ def _ring_flash(q, k, v, axis_name, causal):
 
 def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                    axis_name: str, causal: bool = False,
-                   attn_impl: str = "xla") -> jnp.ndarray:
+                   attn_impl: str = "auto") -> jnp.ndarray:
     """Exact multi-head attention over a sequence-sharded axis.
 
     Call INSIDE ``shard_map``: ``q,k,v`` are the local shards, shape
@@ -118,14 +118,20 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     ``attn_impl``: ``'xla'`` materializes each visiting block's
     ``(B, H, Sq, Sk)`` score matrix (fine at short S); ``'flash'`` runs the
     Pallas kernel per block — O(block) live memory, the long-context
-    configuration.
+    configuration; ``'auto'`` (default) picks flash on TPU whenever the
+    local block is big enough to fill kernel tiles — the long-context
+    module must not default to the path that defeats long context.
     """
+    from ..ops.flash_attention import resolve_attn_impl
+
+    attn_impl = resolve_attn_impl(attn_impl, q.shape[1])
     if attn_impl == "flash":
         # GQA (fewer KV heads than Q heads) passes straight through: the
         # flash kernel shares KV heads in its block index map.
         return _ring_flash(q, k, v, axis_name, causal)
     if attn_impl != "xla":
-        raise ValueError(f"attn_impl must be 'xla' or 'flash', got {attn_impl!r}")
+        raise ValueError(
+            f"attn_impl must be 'auto', 'xla' or 'flash', got {attn_impl!r}")
     if k.shape[2] != q.shape[2]:
         # GQA on the materializing path: expand KV to the q head count (the
         # O(S²) scores already dominate memory here; the flash path is the
@@ -187,13 +193,15 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
 
 def make_ring_attention(mesh: Optional[Mesh] = None,
                         axis_name: Optional[str] = None,
-                        causal: bool = False, attn_impl: str = "xla"):
+                        causal: bool = False, attn_impl: str = "auto"):
     """Eager/jit face over GLOBAL sequence-sharded arrays (see
     ``_factory.make_sp_attention``)."""
     from functools import partial
 
     # Same caveat as make_ulysses_attention: interpreted (CPU) pallas can't
     # propagate varying-axes; the compiled TPU path keeps the check.
+    # ('auto' never resolves to flash off-TPU, so only an explicit 'flash'
+    # request trips this.)
     interpreted_flash = (attn_impl == "flash"
                          and jax.default_backend() != "tpu")
     return make_sp_attention(

@@ -92,7 +92,7 @@ def _project_qkv(h, a, head_dim: int, axis_name: str):
 
 
 def tp_attention(x, params, *, head_dim: int, axis_name: str,
-                 causal: bool = True, attn_impl: str = "xla",
+                 causal: bool = True, attn_impl: str = "auto",
                  positions=None):
     """Multi-head self-attention with heads sharded over ``axis_name``.
 
@@ -103,7 +103,10 @@ def tp_attention(x, params, *, head_dim: int, axis_name: str,
     ``wo (D/P, D)``, replicated ``bo (D,)``.  One psum (in the
     row-parallel output projection) per call.
     """
+    from ..ops.flash_attention import resolve_attn_impl
+
     b, s, d = x.shape
+    attn_impl = resolve_attn_impl(attn_impl, s)
     q, k, v = _project_qkv(x, params, head_dim, axis_name)
     h_local = q.shape[2]
 
@@ -135,7 +138,7 @@ def tp_attention(x, params, *, head_dim: int, axis_name: str,
 
 
 def tp_block(x, params, *, head_dim: int, axis_name: str, causal: bool = True,
-             attn_impl: str = "xla", positions=None):
+             attn_impl: str = "auto", positions=None):
     """Pre-norm transformer block: LN→attn→residual, LN→MLP→residual."""
     h = _layer_norm(x, params["ln1_scale"], params["ln1_bias"])
     x = x + tp_attention(h, params["attn"], head_dim=head_dim,
@@ -174,7 +177,7 @@ def vocab_parallel_logits_loss(h, table, targets, *, axis_name: str):
 
 
 def tp_transformer_lm_loss(params, batch, *, head_dim: int, axis_name: str,
-                           causal: bool = True, attn_impl: str = "xla"):
+                           causal: bool = True, attn_impl: str = "auto"):
     """Per-token mean NLL of a decoder-only LM over the LOCAL batch shard.
 
     ``batch``: ``(tokens (B, S+1) int32,)`` — inputs are ``[:, :-1]``,
@@ -201,7 +204,7 @@ def tp_transformer_lm_loss(params, batch, *, head_dim: int, axis_name: str,
 
 
 def sp_block(x, params, *, head_dim: int, axis_name: str, causal: bool = True,
-             attn_impl: str = "xla", sp_impl: str = "ring", positions=None):
+             attn_impl: str = "auto", sp_impl: str = "ring", positions=None):
     """Transformer block with the SEQUENCE sharded over ``axis_name``.
 
     The long-context configuration (first-class per the rebuild brief;
@@ -253,7 +256,7 @@ def sp_block(x, params, *, head_dim: int, axis_name: str, causal: bool = True,
 
 
 def sp_transformer_lm_loss(params, batch, *, head_dim: int, axis_name: str,
-                           causal: bool = True, attn_impl: str = "xla",
+                           causal: bool = True, attn_impl: str = "auto",
                            sp_impl: str = "ring"):
     """Per-token mean NLL with the SEQUENCE sharded over ``axis_name``.
 

@@ -54,16 +54,22 @@ def _full_attention(q, k, v, causal: bool):
 
 def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                       axis_name: str, causal: bool = False,
-                      attn_impl: str = "xla") -> jnp.ndarray:
+                      attn_impl: str = "auto") -> jnp.ndarray:
     """Exact attention over a sequence-sharded axis via two all-to-alls.
 
     Call INSIDE ``shard_map``: ``q,k,v`` local shards ``(B, S_local, H, D)``
     with ``H`` divisible by the axis size; returns the local output shard.
-    ``attn_impl``: ``'xla'`` (plain softmax attention) or ``'flash'`` (the
+    ``attn_impl``: ``'xla'`` (plain softmax attention), ``'flash'`` (the
     Pallas kernel from ``ops.flash_attention`` — O(block) memory for the
-    local full-sequence attention, the long-context configuration).
+    local full-sequence attention, the long-context configuration), or
+    ``'auto'`` (flash on TPU at non-trivial GLOBAL sequence length — the
+    post-all-to-all attention sees the full sequence).
     """
+    from ..ops.flash_attention import resolve_attn_impl
+
     p_size = jax.lax.psum(1, axis_name)
+    # post-all-to-all attention sees the GLOBAL sequence
+    attn_impl = resolve_attn_impl(attn_impl, q.shape[1] * p_size)
     b, s_local, h, d = q.shape
     h_kv = k.shape[2]
     if h % p_size != 0:
@@ -93,13 +99,14 @@ def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     elif attn_impl == "xla":
         out = _full_attention(qg, kg, vg, causal)
     else:
-        raise ValueError(f"attn_impl must be 'xla' or 'flash', got {attn_impl!r}")
+        raise ValueError(
+            f"attn_impl must be 'auto', 'xla' or 'flash', got {attn_impl!r}")
     return heads_to_seq(out)
 
 
 def make_ulysses_attention(mesh: Optional[Mesh] = None,
                            axis_name: Optional[str] = None,
-                           causal: bool = False, attn_impl: str = "xla"):
+                           causal: bool = False, attn_impl: str = "auto"):
     """Eager/jit face over GLOBAL sequence-sharded arrays (see
     ``_factory.make_sp_attention``)."""
     # check_vma off only for INTERPRETED flash (CPU tests): pallas interpret

@@ -350,6 +350,21 @@ class TestReshardCheckpoint:
             fn = tmp_path / f"job.iter{5:012d}.proc{p}of3"
             assert pickle.loads(fn.read_bytes()) == {"proc": 1}
 
+    def test_same_iteration_two_world_sizes_raises_without_explicit(
+            self, tmp_path):
+        """Iteration 5 complete under BOTH world sizes 1 and 2: auto-pick
+        would silently decide which payload wins — demand iteration=."""
+        from chainermn_tpu.extensions import reshard_checkpoint
+
+        self._write_shard(tmp_path, "job", 5, 0, 1, {"world": 1})
+        for p in range(2):
+            self._write_shard(tmp_path, "job", 5, p, 2, {"world": 2})
+        with pytest.raises(RuntimeError, match="multiple world sizes"):
+            reshard_checkpoint(str(tmp_path), "job", new_nproc=1)
+        # explicit iteration confirms; largest world size wins, documented
+        assert reshard_checkpoint(str(tmp_path), "job", new_nproc=1,
+                                  iteration=5) == 5
+
     def test_incomplete_generation_rejected(self, tmp_path):
         from chainermn_tpu.extensions import reshard_checkpoint
 

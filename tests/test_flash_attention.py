@@ -61,12 +61,13 @@ class TestForward:
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    rtol=2e-4, atol=2e-5)
 
-    def test_pad_and_mask_gradients(self):
+    @pytest.mark.parametrize("backward", ["pallas", "xla"])
+    def test_pad_and_mask_gradients(self, backward):
         q, k, v = qkv(s=97)
 
         def loss_flash(q, k, v):
-            return (flash_attention(q, k, v, causal=True,
-                                    block_q=32, block_k=32) ** 2).sum()
+            return (flash_attention(q, k, v, causal=True, block_q=32,
+                                    block_k=32, backward=backward) ** 2).sum()
 
         def loss_ref(q, k, v):
             return (reference(q, k, v, causal=True) ** 2).sum()
@@ -88,13 +89,14 @@ class TestForward:
 
 
 class TestBackward:
+    @pytest.mark.parametrize("backward", ["pallas", "xla"])
     @pytest.mark.parametrize("causal", [False, True])
-    def test_gradients_match_reference(self, causal):
+    def test_gradients_match_reference(self, causal, backward):
         q, k, v = qkv(seed=2)
 
         def floss(q, k, v):
-            return (flash_attention(q, k, v, causal=causal,
-                                    block_q=16, block_k=16) ** 2).sum()
+            return (flash_attention(q, k, v, causal=causal, block_q=16,
+                                    block_k=16, backward=backward) ** 2).sum()
 
         def rloss(q, k, v):
             return (reference(q, k, v, causal) ** 2).sum()
@@ -105,6 +107,29 @@ class TestBackward:
             np.testing.assert_allclose(np.asarray(g), np.asarray(w),
                                        rtol=5e-4, atol=5e-5,
                                        err_msg=f"grad wrt {name}")
+
+    def test_pallas_matches_xla_backward_with_lse_cotangent(self):
+        """The two backends must agree when gradients also flow through the
+        LSE output (ring attention's block-merge weights)."""
+        q, k, v = qkv(seed=4)
+
+        def loss(backward):
+            def f(q, k, v):
+                o, lse = flash_attention(q, k, v, causal=True,
+                                         return_lse=True, backward=backward)
+                return (o ** 2).sum() + jnp.sin(lse).sum()
+            return jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+
+        for g, w, name in zip(loss("pallas"), loss("xla"), "qkv"):
+            np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                       rtol=1e-5, atol=1e-6,
+                                       err_msg=f"grad wrt {name}")
+
+    def test_bad_backward_name_raises(self):
+        q, k, v = qkv()
+        with pytest.raises(ValueError, match="backward"):
+            jax.grad(lambda q: flash_attention(
+                q, k, v, backward="nope").sum())(q)
 
 
 def qkv8(seed=0):
@@ -158,15 +183,16 @@ class TestGQA:
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    rtol=2e-4, atol=2e-5)
 
-    def test_gradients_match_reference(self):
+    @pytest.mark.parametrize("backward", ["pallas", "xla"])
+    def test_gradients_match_reference(self, backward):
         rng = np.random.RandomState(1)
         q = rng.randn(B, S, H, D).astype(np.float32)
         k = rng.randn(B, S, 2, D).astype(np.float32)
         v = rng.randn(B, S, 2, D).astype(np.float32)
 
         def loss_flash(q, k, v):
-            return (flash_attention(q, k, v, causal=True,
-                                    block_q=32, block_k=32) ** 2).sum()
+            return (flash_attention(q, k, v, causal=True, block_q=32,
+                                    block_k=32, backward=backward) ** 2).sum()
 
         def loss_ref(q, k, v):
             return (self._reference_gqa(q, k, v, causal=True) ** 2).sum()
