@@ -53,12 +53,33 @@ PEAK_BF16_FLOPS = [
 ]
 
 
+# HBM bandwidth (bytes/s) per chip by TPU generation (public spec sheets).
+HBM_BYTES_PER_S = [
+    ("v6e", 1.64e12),
+    ("trillium", 1.64e12),
+    ("v5p", 2.765e12),
+    ("v5e", 8.19e11),
+    ("v5 lite", 8.19e11),
+    ("v4", 1.228e12),
+    ("v3", 9.0e11),
+    ("v2", 7.0e11),
+]
+
+
 def peak_flops_for(device_kind: str):
     kind = device_kind.lower()
     for key, peak in PEAK_BF16_FLOPS:
         if key in kind:
             return peak
     return None  # CPU / unknown: MFU not meaningful
+
+
+def hbm_bw_for(device_kind: str):
+    kind = device_kind.lower()
+    for key, bw in HBM_BYTES_PER_S:
+        if key in kind:
+            return bw
+    return None
 
 
 def build_step(arch, image_size, per_chip_batch, allreduce_grad_dtype=None):
@@ -103,9 +124,12 @@ def build_step(arch, image_size, per_chip_batch, allreduce_grad_dtype=None):
 
 
 def compile_with_flops(step, variables, opt_state, batch):
-    """AOT-compile the step once; return (callable, flops) — the same
-    executable is then timed, so the compile cost is paid exactly once.
-    One retry: the remote-compile tunnel drops connections transiently."""
+    """AOT-compile the step once; return (callable, flops, bytes_accessed)
+    — the same executable is then timed, so the compile cost is paid
+    exactly once.  ``bytes_accessed`` feeds the HBM roofline (see
+    docs/PERF.md — ResNet-50 is bandwidth-bound on v5e, so FLOPs alone
+    misdiagnose it).  One retry: the remote-compile tunnel drops
+    connections transiently."""
     compiled = None
     for attempt in (1, 2):
         try:
@@ -115,16 +139,17 @@ def compile_with_flops(step, variables, opt_state, batch):
             print(f"bench: AOT lower/compile failed (try {attempt}: {e!r})",
                   file=sys.stderr)
     if compiled is None:
-        return step, None
-    flops = None
+        return step, None, None
+    flops, nbytes = None, None
     try:
         cost = compiled.cost_analysis()
         if isinstance(cost, (list, tuple)):
             cost = cost[0]
         flops = float(cost.get("flops", 0.0)) or None
+        nbytes = float(cost.get("bytes accessed", 0.0)) or None
     except Exception as e:  # pragma: no cover
         print(f"bench: cost_analysis unavailable ({e!r})", file=sys.stderr)
-    return compiled, flops
+    return compiled, flops, nbytes
 
 
 def measure(step, variables, opt_state, batch, steps):
@@ -191,7 +216,7 @@ def bench_transformer_lm(n_chips_hint=None):
         0, vocab, (per_chip_batch * n_chips, seq + 1)).astype(np.int32)
     batch = (jax.device_put(tokens, NamedSharding(mesh, P("data"))),)
 
-    step_c, flops_per_step = compile_with_flops(step, p, st, batch)
+    step_c, flops_per_step, _ = compile_with_flops(step, p, st, batch)
     # 40 steps per host readback: the axon tunnel's readback costs ~100ms
     # flat (measured), so few-step loops inflate per-step time by ~10ms.
     steps = 40
@@ -212,6 +237,8 @@ def bench_transformer_lm(n_chips_hint=None):
     dev = jax.devices()[0]
     peak = peak_flops_for(dev.device_kind)
     mfu = flops_per_step * steps / dt / peak if peak else None
+    analytic_step = (6.0 * n_params + 12.0 * n_layers * d_model * seq) * toks
+    mfu_useful = analytic_step * steps / dt / peak if peak else None
     suspect = bool(mfu and mfu > 1.0)
     if suspect:
         print(f"bench: WARNING transformer MFU {mfu:.2f} > 1.0 impossible — "
@@ -219,6 +246,7 @@ def bench_transformer_lm(n_chips_hint=None):
     return {
         "tokens_per_sec_per_chip": round(tps, 1),
         "mfu": round(mfu, 4) if mfu else None,
+        "mfu_useful": round(mfu_useful, 4) if mfu_useful else None,
         "suspect": suspect,
         "flops_source": flops_source,
         "n_params": int(n_params),
@@ -227,49 +255,330 @@ def bench_transformer_lm(n_chips_hint=None):
     }
 
 
-def scaling_worker(n):
-    """Subprocess body: weak-scaling point on an n-device virtual CPU mesh."""
+def bench_data_path():
+    """Disk-fed vs synthetic input pipeline at batch 128 on the real chip.
+
+    Two measurements, same ResNet-50 step and identical consumption path
+    (prefetch ring → copy → shard_batch → device) — only the record source
+    differs: (a) in-memory buffer, (b) on-disk record file pread by the
+    C++ workers.  Also reports ASSEMBLY-ONLY throughput for both sources
+    (iterator drained with no training step), the pure input-pipeline
+    capability number: it must exceed the chip's consumption rate
+    (~2.8k img/s) for the loader to never stall training.
+    """
+    import shutil
+    import tempfile
+
     import jax
+    import numpy as np
+
+    import chainermn_tpu as mn
+
+    b, img, n_records, steps = 128, 224, 1024, 15
+    rng = np.random.RandomState(0)
+    records = rng.randn(n_records, img, img, 3).astype(np.float32)
+    labels = rng.randint(0, 1000, n_records).astype(np.int32)
+    tmp = tempfile.mkdtemp(prefix="bench_data_")
+    out = {"batch": b, "n_records": n_records, "steps": steps}
+    try:
+        mn.write_file_dataset(tmp, [records, labels])
+        disk = mn.FileDataset(tmp)
+
+        def assembly_ips(dataset):
+            it = mn.PrefetchIterator(dataset, batch_size=b, seed=1, copy=True)
+            next(it)  # spin up the ring
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                next(it)
+            dt = time.perf_counter() - t0
+            it.close()
+            return steps * b / dt
+
+        out["assembly_ips_memory"] = round(assembly_ips((records, labels)), 1)
+        out["assembly_ips_disk"] = round(assembly_ips(disk), 1)
+        out["note"] = ("train_ips here includes a ~77MB/batch host->device "
+                       "upload through the axon tunnel (the binding "
+                       "constraint in this environment, identical for both "
+                       "sources); assembly_ips isolates the loader itself, "
+                       "dominated by the copy=True detach memcpy")
+
+        step, variables, opt_state, _, n_chips, _ = build_step(
+            "resnet50", img, b)
+        mesh = mn.create_communicator("xla").mesh
+
+        def train_ips(dataset, variables, opt_state):
+            it = mn.PrefetchIterator(dataset, batch_size=b, seed=1, copy=True)
+            batch = mn.shard_batch(next(it), mesh)
+            variables, opt_state, loss, _ = step(variables, opt_state, batch)
+            float(loss)  # compile barrier
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                batch = mn.shard_batch(next(it), mesh)
+                variables, opt_state, loss, _ = step(
+                    variables, opt_state, batch)
+            float(loss)  # host readback barrier
+            dt = time.perf_counter() - t0
+            it.close()
+            return steps * b / dt, variables, opt_state
+
+        ips_mem, variables, opt_state = train_ips(
+            (records, labels), variables, opt_state)
+        ips_disk, _, _ = train_ips(disk, variables, opt_state)
+        out["train_ips_memory"] = round(ips_mem, 1)
+        out["train_ips_disk"] = round(ips_disk, 1)
+        out["disk_vs_memory_pct"] = round(100.0 * ips_disk / ips_mem, 1)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    return out
+
+
+def bench_decode():
+    """Generation perf over the KV cache on the real chip: prefill vs
+    decode split, tokens/s and per-token latency, greedy and beam.
+
+    Method: one jitted program covers prefill + scan-decode, so timing a
+    ``max_new=1`` run isolates (approximately) the prefill; the greedy
+    512-token run minus that is pure incremental decode.  Best-of-3 with
+    the ~100ms tunnel readback RTT subtracted."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import chainermn_tpu as mn
+    from chainermn_tpu.parallel import (
+        init_tp_transformer_lm, make_lm_beam_generator, make_lm_generator,
+        shard_pytree, transformer_lm_specs)
+
+    vocab, d_model, n_heads, n_layers = 32768, 1024, 16, 8
+    b, s_prompt, new = 8, 512, 512
+    n_chips = len(jax.devices())
+    mesh = mn.make_nd_mesh(("model",), (n_chips,))
+    params = init_tp_transformer_lm(
+        jax.random.PRNGKey(0), vocab, d_model, n_heads, n_layers,
+        max_len=s_prompt + new, dtype=jnp.bfloat16)
+    p = shard_pytree(params, mesh, transformer_lm_specs(params, "model"))
+    prompt = jnp.asarray(np.random.RandomState(0).randint(
+        0, vocab, (b, s_prompt)), jnp.int32)
+
+    def timed(fn, *args, reps=5):
+        """Dispatch ``reps`` runs back-to-back, one readback at the end:
+        device execution is FIFO, so the final array bounds them all and
+        the ~100ms tunnel readback RTT amortizes over reps instead of
+        swamping (or, subtracted naively, NEGATING) a short run."""
+        out = fn(*args)
+        np.asarray(out)  # compile + readback barrier
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(reps - 1):
+                fn(*args)
+            np.asarray(fn(*args))
+            best = min(best, (time.perf_counter() - t0 - 0.1) / reps)
+        return max(best, 1e-4)
+
+    hd = d_model // n_heads
+    prefill = timed(make_lm_generator(
+        mesh, head_dim=hd, max_new_tokens=1), p, prompt)
+    greedy = timed(make_lm_generator(
+        mesh, head_dim=hd, max_new_tokens=new), p, prompt)
+    decode_s = max(greedy - prefill, 1e-9)
+    beam = timed(make_lm_beam_generator(
+        mesh, head_dim=hd, max_new_tokens=new, beam_size=4), p, prompt)
+    beam_decode_s = max(beam - prefill, 1e-9)
+    return {
+        "config": f"d{d_model} L{n_layers} h{n_heads} V{vocab} "
+                  f"b{b} prompt{s_prompt} new{new} bf16",
+        "prefill_ms": round(prefill * 1e3, 1),
+        "prefill_tokens_per_sec": round(b * s_prompt / prefill, 1),
+        "greedy_tokens_per_sec": round(b * new / decode_s, 1),
+        "greedy_ms_per_token": round(decode_s / new * 1e3, 3),
+        "beam4_tokens_per_sec": round(b * new / beam_decode_s, 1),
+        "beam4_ms_per_token": round(beam_decode_s / new * 1e3, 3),
+    }
+
+
+def scaling_worker(n, grad_dtype=None):
+    """Subprocess body: weak-scaling point on an n-device virtual CPU mesh.
+
+    Besides the train-step throughput, directly times the gradient-sized
+    pmean ALONE (scan-chained inside one jit) so the sweep can attribute
+    efficiency loss to the wire collective vs everything else."""
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
 
     # The env var alone loses to experimental TPU plugins (axon); the
     # in-process override before backend init is authoritative.
     jax.config.update("jax_platforms", "cpu")
     step, variables, opt_state, batch, n_chips, global_batch = build_step(
-        "resnet18", 32, 8)
+        "resnet18", 32, 8, allreduce_grad_dtype=grad_dtype)
     assert n_chips == n, (n_chips, n)
-    dt, _ = measure(step, variables, opt_state, batch, steps=3)
-    print(json.dumps({"n": n, "total_ips": 3 * global_batch / dt}))
+    steps = 3 if n <= 8 else 2
+    dt, _ = measure(step, variables, opt_state, batch, steps=steps)
+    out = {"n": n, "total_ips": steps * global_batch / dt,
+           "step_ms": dt / steps * 1e3}
+
+    # gradient-sized pmean in isolation (same dtype as the wire)
+    if n > 1:
+        from jax.sharding import PartitionSpec as P
+        from jax.experimental.shard_map import shard_map
+
+        import chainermn_tpu as mn
+
+        mesh = mn.make_mesh(axis_name="mn")
+        sizes = [int(np.prod(l.shape)) for l in
+                 jax.tree_util.tree_leaves(variables["params"])]
+        payload = jnp.zeros((sum(sizes),),
+                            jnp.bfloat16 if grad_dtype else jnp.float32)
+        reps = 10
+
+        @jax.jit
+        def psum_chain(x):
+            def body(c, _):
+                return jax.lax.pmean(c, "mn") * 0.999, None
+            y, _ = jax.lax.scan(body, x, None, length=reps)
+            return y.sum()
+
+        run = jax.jit(shard_map(psum_chain, mesh=mesh, in_specs=P(),
+                                out_specs=P()))
+        float(np.asarray(run(payload)))  # compile
+        t0 = _time.perf_counter()
+        float(np.asarray(run(payload)))
+        out["grad_pmean_ms"] = (_time.perf_counter() - t0) / reps * 1e3
+        out["grad_bytes"] = int(payload.size * payload.dtype.itemsize)
+    print(json.dumps(out))
 
 
-def run_scaling_sweep(ns=(1, 2, 4, 8)):
-    """Weak-scaling sweep in fresh CPU subprocesses (platform is per-process)."""
-    results = {}
-    for n in ns:
+def run_scaling_sweep(ns=(1, 2, 4, 8, 16, 32)):
+    """Weak-scaling sweep in fresh CPU subprocesses (platform is per-process).
+
+    Reports per-point efficiency vs n=1 and the measured gradient-pmean
+    time, plus one COMPRESSED point (bf16 wire) at n=8 so the
+    ``allreduce_grad_dtype`` feature finally has a recorded number
+    (reference frame: the v1.2 double-buffering/fp16-allreduce headline,
+    SURVEY.md §6)."""
+    def run_point(n, grad_dtype=None):
         env = dict(os.environ)
         env["JAX_PLATFORMS"] = "cpu"
         env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
                             + f" --xla_force_host_platform_device_count={n}")
-        print(f"bench: scaling point n={n} ...", file=sys.stderr)
+        tag = f"n={n}" + (f" wire={grad_dtype}" if grad_dtype else "")
+        print(f"bench: scaling point {tag} ...", file=sys.stderr)
+        cmd = [sys.executable, os.path.abspath(__file__),
+               "--scaling-worker", str(n)]
+        if grad_dtype:
+            cmd += ["--allreduce-grad-dtype", grad_dtype]
         out = None
         try:
-            out = subprocess.run(
-                [sys.executable, os.path.abspath(__file__),
-                 "--scaling-worker", str(n)],
-                capture_output=True, text=True, timeout=900, env=env)
-            line = out.stdout.strip().splitlines()[-1]
-            results[str(n)] = round(json.loads(line)["total_ips"], 2)
+            out = subprocess.run(cmd, capture_output=True, text=True,
+                                 timeout=1800, env=env)
+            return json.loads(out.stdout.strip().splitlines()[-1])
         except Exception as e:
-            print(f"bench: scaling point n={n} failed: {e!r}\n"
+            print(f"bench: scaling point {tag} failed: {e!r}\n"
                   f"{out.stderr[-2000:] if out is not None else ''}",
                   file=sys.stderr)
-            results[str(n)] = None
-    base = results.get("1")
-    top = results.get(str(ns[-1]))
-    eff = round(100.0 * top / base, 1) if base and top else None
-    return {"per_chip_batch": 8, "arch": "resnet18", "total_ips": results,
-            "efficiency_pct": eff,
-            "note": "virtual CPU mesh: ideal weak scaling = flat TOTAL "
-                    "throughput; efficiency isolates collective overhead"}
+            return None
+
+    points = {}
+    for n in ns:
+        points[str(n)] = run_point(n)
+    base = (points.get("1") or {}).get("total_ips")
+    for p in points.values():
+        if p and base:
+            p["eff_pct"] = round(100.0 * p["total_ips"] / base, 1)
+        if p:
+            p["total_ips"] = round(p["total_ips"], 2)
+            for k in ("step_ms", "grad_pmean_ms"):
+                if k in p:
+                    p[k] = round(p[k], 1)
+    compressed = run_point(8, grad_dtype="bfloat16")
+    if compressed and base:
+        compressed["eff_pct"] = round(100.0 * compressed["total_ips"] / base, 1)
+        compressed["total_ips"] = round(compressed["total_ips"], 2)
+    eff8 = (points.get("8") or {}).get("eff_pct")
+    try:
+        cores = os.cpu_count()
+    except Exception:
+        cores = None
+    return {"per_chip_batch": 8, "arch": "resnet18", "points": points,
+            "compressed_bf16_n8": compressed,
+            "efficiency_pct": eff8,
+            "host_physical_cores": cores,
+            "total_ips": {k: (p or {}).get("total_ips") for k, p in
+                          points.items()},
+            "note": "virtual CPU mesh TIME-SHARED on the host cores "
+                    "(this box: see host_physical_cores): ideal weak "
+                    "scaling = flat TOTAL throughput, and the efficiency "
+                    "loss measures XLA per-device scheduling + emulated "
+                    "collective overhead, NOT interconnect behavior — "
+                    "grad_pmean_ms (the wire collective timed alone, "
+                    "scan-chained) gives the collective's share directly; "
+                    "see projected_scaling for the ICI-based pod "
+                    "projection from measured single-chip quantities"}
+
+
+def project_dp_scaling(step_ms: float, grad_bytes: int, device_kind: str,
+                       wire_dtype_bytes: int = 4):
+    """Project DP allreduce scaling efficiency to pod scale from measured
+    single-chip quantities + public interconnect specs.
+
+    Methodology (docs/SCALING.md): a bidirectional-ring allreduce moves
+    ``2·(P-1)/P · bytes`` per chip; time = α·(P-1) + that / BW_ici.  One
+    chip cannot measure ICI, so BW/α come from public v5e specs (stated
+    below); step time and gradient size ARE measured.  The multislice row
+    models the ICI-reduce → DCN-cross-slice → ICI-bcast two-tier mean of
+    ``ops.collective.hierarchical_pmean`` with the slice count's share of
+    DCN per host.  Efficiency assumes NO compute/comm overlap — a lower
+    bound; the double-buffered optimizer hides most of the wire time.
+    """
+    # Interconnect specs per generation (public material); unknown kinds
+    # fall back to v5e numbers WITH the mismatch flagged in the output.
+    ici_specs = {
+        "v5e": (1.8e11, 4), "v5 lite": (1.8e11, 4),
+        "v4": (2.4e11, 4), "v5p": (4.8e11, 4),
+        "v6e": (3.6e11, 4), "trillium": (3.6e11, 4),
+    }
+    kind = device_kind.lower()
+    match = next((k for k in ici_specs if k in kind), None)
+    bw_ici, chips_per_host = ici_specs[match or "v5e"]
+    assumptions = {
+        "ici_bw_bytes_per_s": bw_ici,
+        "ici_spec_source": (f"{match} table entry" if match else
+                            f"v5e defaults ({device_kind!r} not in table)"),
+        "ici_alpha_us_per_hop": 1.0,
+        "dcn_bw_bytes_per_s_per_host": 2.5e10,  # 200 Gbps NIC per host
+        "chips_per_host": chips_per_host,
+        "overlap": "none (lower bound); double-buffering hides wire time",
+    }
+    wire = grad_bytes * wire_dtype_bytes // 4
+    out = {"assumptions": assumptions, "measured_step_ms": step_ms,
+           "grad_bytes_fp32": grad_bytes, "points": {}}
+    for p in (8, 64, 256):
+        ring = 2.0 * (p - 1) / p * wire / assumptions["ici_bw_bytes_per_s"]
+        ring += (p - 1) * assumptions["ici_alpha_us_per_hop"] * 1e-6
+        eff = step_ms / (step_ms + ring * 1e3) * 100.0
+        out["points"][str(p)] = {
+            "allreduce_ms": round(ring * 1e3, 2),
+            "efficiency_pct": round(eff, 1),
+        }
+    # 256 chips as 4 slices of 64 over DCN (hierarchical_pmean path):
+    # ICI reduce within slice + cross-slice exchange of the full gradient
+    # per host-pair over DCN + ICI bcast.
+    slices, per_slice = 4, 64
+    ici = 2.0 * (per_slice - 1) / per_slice * wire / assumptions[
+        "ici_bw_bytes_per_s"] * 2  # reduce + bcast legs
+    hosts_per_slice = per_slice // assumptions["chips_per_host"]
+    dcn = (2.0 * (slices - 1) / slices * wire / hosts_per_slice
+           / assumptions["dcn_bw_bytes_per_s_per_host"])
+    eff = step_ms / (step_ms + (ici + dcn) * 1e3) * 100.0
+    out["points"]["256_multislice_4x64"] = {
+        "allreduce_ms": round((ici + dcn) * 1e3, 2),
+        "efficiency_pct": round(eff, 1),
+        "dcn_share_ms": round(dcn * 1e3, 2),
+    }
+    return out
 
 
 def main():
@@ -280,7 +589,7 @@ def main():
     args = parser.parse_args()
 
     if args.scaling_worker is not None:
-        scaling_worker(args.scaling_worker)
+        scaling_worker(args.scaling_worker, args.allreduce_grad_dtype)
         return
 
     import jax
@@ -298,7 +607,12 @@ def main():
 
     step, variables, opt_state, batch, n_chips, global_batch = build_step(
         "resnet50", image_size, per_chip_batch, args.allreduce_grad_dtype)
-    step, flops_per_step = compile_with_flops(step, variables, opt_state, batch)
+    import numpy as _np
+    grad_bytes = int(sum(
+        _np.prod(l.shape) for l in
+        jax.tree_util.tree_leaves(variables["params"])) * 4)
+    step, flops_per_step, bytes_per_step = compile_with_flops(
+        step, variables, opt_state, batch)
     dt, _ = measure(step, variables, opt_state, batch, steps)
     ips_per_chip = steps * global_batch / dt / n_chips
 
@@ -348,6 +662,24 @@ def main():
         if peak and flops_per_image:
             return round(ips * flops_per_image / peak, 4)
         return None
+
+    def mfu_useful_of(ips):
+        # MLPerf-style utilization from ANALYTIC model FLOPs; the compiled
+        # count runs ~2x higher for conv backwards (docs/PERF.md).
+        return round(ips * analytic / peak, 4) if peak else None
+
+    # --- HBM roofline: is the step bandwidth- or compute-bound? ----------
+    roofline = None
+    bw = hbm_bw_for(dev.device_kind) if on_tpu else None
+    if bw and peak and flops_per_step and bytes_per_step:
+        t_mxu = flops_per_step / peak * 1e3
+        t_hbm = bytes_per_step / bw * 1e3
+        roofline = {
+            "bytes_per_step": round(bytes_per_step),
+            "t_mxu_ms": round(t_mxu, 2),
+            "t_hbm_ms": round(t_hbm, 2),
+            "bound": "hbm" if t_hbm > t_mxu else "mxu",
+        }
 
     # --- per-chip batch sweep on the real chip -----------------------------
     batch_sweep = {}
@@ -400,6 +732,33 @@ def main():
         except Exception as e:
             print(f"bench: transformer section failed: {e!r}", file=sys.stderr)
 
+    # --- decode: generation perf over the KV cache -------------------------
+    decode = None
+    if on_tpu:
+        try:
+            decode = bench_decode()
+        except Exception as e:
+            print(f"bench: decode section failed: {e!r}", file=sys.stderr)
+
+    # --- input pipeline: disk-fed vs synthetic -----------------------------
+    data_path = None
+    if on_tpu:
+        try:
+            data_path = bench_data_path()
+        except Exception as e:
+            print(f"bench: data-path section failed: {e!r}", file=sys.stderr)
+
+    # --- projected pod-scale DP efficiency (measured step + spec ICI) ------
+    projected = None
+    if on_tpu:
+        step_ms = dt / steps * 1e3
+        projected = {
+            "fp32_wire": project_dp_scaling(step_ms, grad_bytes,
+                                            dev.device_kind, 4),
+            "bf16_wire": project_dp_scaling(step_ms, grad_bytes,
+                                            dev.device_kind, 2),
+        }
+
     # --- DP weak-scaling sweep (virtual CPU mesh, fresh subprocesses) ------
     scaling = None if args.skip_scaling else run_scaling_sweep()
 
@@ -409,6 +768,8 @@ def main():
         "unit": "images/sec/chip",
         "vs_baseline": round(headline_ips / REFERENCE_IMAGES_PER_SEC_PER_CHIP, 3),
         "mfu": mfu_of(headline_ips),
+        "mfu_useful": mfu_useful_of(headline_ips),
+        "roofline": roofline,
         "suspect": suspect,
         "device_kind": dev.device_kind,
         "headline_batch": int(headline_batch),
@@ -417,6 +778,9 @@ def main():
         "allreduce_grad_dtype": args.allreduce_grad_dtype,
         "batch_sweep": batch_sweep,
         "transformer_lm": transformer,
+        "decode": decode,
+        "data_path": data_path,
+        "projected_scaling": projected,
         "scaling": scaling,
     }))
 

@@ -9,7 +9,8 @@ or mpi4py anywhere in the import graph.
 """
 
 from . import extensions, functions, global_except_hook, iterators, links, ops, parallel, runtime, training  # noqa: F401
-from .runtime import PrefetchIterator  # noqa: F401
+from .runtime import (FileDataset, PrefetchIterator,  # noqa: F401
+                      write_file_dataset)
 from .parallel import (  # noqa: F401
     column_parallel_dense,
     make_moe_mlp,

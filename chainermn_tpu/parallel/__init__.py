@@ -60,7 +60,9 @@ from .transformer import (  # noqa: F401
     sp_block,
     sp_transformer_lm_loss,
     tp_attention,
+    tp_attention_sp,
     tp_block,
+    tp_block_sp,
     tp_transformer_lm_loss,
     transformer_lm_specs,
     vocab_parallel_logits_loss,
@@ -70,7 +72,10 @@ from .tensor_parallel import (  # noqa: F401
     init_tp_mlp_params,
     make_tensor_parallel_mlp,
     row_parallel_dense,
+    gather_seq_matmul,
+    matmul_scatter_seq,
     tp_mlp,
+    tp_mlp_sp,
     tp_mlp_specs,
     vocab_parallel_embedding,
 )
@@ -93,6 +98,9 @@ __all__ = [
     "row_parallel_dense",
     "vocab_parallel_embedding",
     "tp_mlp",
+    "tp_mlp_sp",
+    "gather_seq_matmul",
+    "matmul_scatter_seq",
     "init_tp_mlp_params",
     "tp_mlp_specs",
     "make_tensor_parallel_mlp",
@@ -119,7 +127,9 @@ __all__ = [
     "sp_block",
     "sp_transformer_lm_loss",
     "tp_attention",
+    "tp_attention_sp",
     "tp_block",
+    "tp_block_sp",
     "tp_transformer_lm_loss",
     "transformer_lm_specs",
     "vocab_parallel_logits_loss",

@@ -115,6 +115,64 @@ def tp_mlp(x, params, *, axis_name: str,
                               axis_name=axis_name)
 
 
+def gather_seq_matmul(x, w, bias=None, *, axis_name: str):
+    """Megatron-SP entry: ``x (B, S/P, D)`` SEQUENCE-sharded →
+    ``(B, S, F_loc)`` via :func:`collective_matmul.all_gather_matmul`, so
+    the sequence all-gather rides the ring overlapped with the projection
+    instead of serializing before it.  ``w``: column shard ``(D, F/P)``."""
+    from .collective_matmul import all_gather_matmul
+
+    b, s_loc, d = x.shape
+    p = jax.lax.axis_size(axis_name)
+    y = all_gather_matmul(x.reshape(b * s_loc, d), w, axis_name=axis_name)
+    y = y.reshape(p, b, s_loc, -1).transpose(1, 0, 2, 3).reshape(
+        b, p * s_loc, -1).astype(x.dtype)
+    if bias is not None:
+        y = y + bias
+    return y
+
+
+def matmul_scatter_seq(x, w, bias=None, *, axis_name: str):
+    """Megatron-SP exit: ``x (B, S, F/P)`` (contraction-sharded features) →
+    ``(B, S/P, D)`` sequence-sharded, via
+    :func:`collective_matmul.matmul_reduce_scatter` — the reduce-scatter
+    replaces ``row_parallel_dense``'s psum AND returns only this rank's
+    sequence rows, with each ring hop overlapping the next chunk's matmul.
+    ``bias``: replicated ``(D,)``, added after the reduction (once)."""
+    from .collective_matmul import matmul_reduce_scatter
+
+    b, s, f = x.shape
+    p = jax.lax.axis_size(axis_name)
+    if s % p:
+        raise ValueError(f"sequence {s} not divisible by axis size {p}")
+    s_loc = s // p
+    x2 = x.reshape(b, p, s_loc, f).transpose(1, 0, 2, 3).reshape(
+        p * b * s_loc, f)
+    y = matmul_reduce_scatter(x2, w, axis_name=axis_name)
+    y = y.reshape(b, s_loc, -1).astype(x.dtype)
+    if bias is not None:
+        y = y + bias
+    return y
+
+
+def tp_mlp_sp(x, params, *, axis_name: str,
+              activation: Callable = jax.nn.gelu):
+    """Megatron-SP MLP over SEQUENCE-sharded activations ``(B, S/P, D)``.
+
+    Same params as :func:`tp_mlp`; differs in the activation contract and
+    the collectives: entry all-gather and exit reduce-scatter both ride
+    the ppermute ring overlapped with their adjacent matmuls
+    (`collective_matmul`).  Per-chip activation memory between blocks
+    drops by P and the replicated-activation psum disappears.  Exactly
+    equals ``tp_mlp`` on the gathered sequence up to reassociation —
+    pinned by tests.
+    """
+    h = gather_seq_matmul(x, params["wi"], params["bi"], axis_name=axis_name)
+    h = activation(h)
+    return matmul_scatter_seq(h, params["wo"], params["bo"],
+                              axis_name=axis_name)
+
+
 def init_tp_mlp_params(rng, d_model: int, d_hidden: int,
                        dtype=jnp.float32) -> dict:
     """GLOBAL (unsharded) params for :func:`tp_mlp`; shard with

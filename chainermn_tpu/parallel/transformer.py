@@ -114,27 +114,35 @@ def tp_attention(x, params, *, head_dim: int, axis_name: str,
         q = apply_rope(q, positions)
         k = apply_rope(k, positions)
 
-    if attn_impl == "flash":
-        from ..ops.flash_attention import flash_attention
-        ctx = flash_attention(q, k, v, causal=causal)
-    else:
-        if k.shape[2] != h_local:  # GQA on the materializing path
-            g = h_local // k.shape[2]
-            k = jnp.repeat(k, g, axis=2)
-            v = jnp.repeat(v, g, axis=2)
-        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
-                            preferred_element_type=jnp.float32)
-        scores = scores / (head_dim ** 0.5)
-        if causal:
-            mask = jnp.arange(s)[:, None] >= jnp.arange(s)[None, :]
-            scores = jnp.where(mask[None, None], scores, -1e30)
-        p = jax.nn.softmax(scores, axis=-1)
-        ctx = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v,
-                         preferred_element_type=jnp.float32).astype(x.dtype)
-
+    ctx = _attend_local_heads(q, k, v, causal=causal, attn_impl=attn_impl,
+                              head_dim=head_dim)
     ctx = ctx.reshape(b, s, h_local * head_dim)             # (B, S, D/P)
     return row_parallel_dense(ctx, params["wo"], params["bo"],
                               axis_name=axis_name)
+
+
+def _attend_local_heads(q, k, v, *, causal, attn_impl, head_dim):
+    """Attention over this chip's heads, full sequence: ``q (B, S, Hl, hd)``,
+    GQA-aware (``k``/``v`` may carry fewer heads).  Shared by the
+    replicated-activation (:func:`tp_attention`) and Megatron-SP
+    (:func:`tp_attention_sp`) paths."""
+    if attn_impl == "flash":
+        from ..ops.flash_attention import flash_attention
+        return flash_attention(q, k, v, causal=causal)
+    h_local, s = q.shape[2], q.shape[1]
+    if k.shape[2] != h_local:  # GQA on the materializing path
+        g = h_local // k.shape[2]
+        k = jnp.repeat(k, g, axis=2)
+        v = jnp.repeat(v, g, axis=2)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32)
+    scores = scores / (head_dim ** 0.5)
+    if causal:
+        mask = jnp.arange(s)[:, None] >= jnp.arange(s)[None, :]
+        scores = jnp.where(mask[None, None], scores, -1e30)
+    p = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+                      preferred_element_type=jnp.float32).astype(q.dtype)
 
 
 def tp_block(x, params, *, head_dim: int, axis_name: str, causal: bool = True,
@@ -146,6 +154,69 @@ def tp_block(x, params, *, head_dim: int, axis_name: str, causal: bool = True,
                          attn_impl=attn_impl, positions=positions)
     h = _layer_norm(x, params["ln2_scale"], params["ln2_bias"])
     return x + tp_mlp(h, params["mlp"], axis_name=axis_name)
+
+
+def tp_attention_sp(x, params, *, head_dim: int, axis_name: str,
+                    causal: bool = True, attn_impl: str = "auto",
+                    positions=None):
+    """Megatron-SP attention: ``x (B, S/P, D)`` SEQUENCE-sharded.
+
+    The entry sequence all-gather fuses into the QKV projection
+    (:func:`tensor_parallel.gather_seq_matmul` — ring hops overlap the
+    matmul chunks) and the exit is a fused matmul+reduce-scatter back to
+    sequence shards, replacing :func:`tp_attention`'s psum.  Heads stay
+    TP-sharded; attention itself sees the full sequence.  ``positions``
+    must be the GLOBAL ``arange(S)`` (attention runs post-gather).
+    """
+    from ..ops.flash_attention import resolve_attn_impl
+
+    from .tensor_parallel import gather_seq_matmul, matmul_scatter_seq
+
+    b, s_loc, d = x.shape
+    s = s_loc * jax.lax.axis_size(axis_name)
+    attn_impl = resolve_attn_impl(attn_impl, s)
+    if "wq" in params:
+        q = gather_seq_matmul(x, params["wq"], params["bq"],
+                              axis_name=axis_name).reshape(b, s, -1, head_dim)
+        kv = gather_seq_matmul(x, params["wkv"], params["bkv"],
+                               axis_name=axis_name)
+        kv = kv.reshape(b, s, -1, 2, head_dim)
+        k, v = kv[..., 0, :], kv[..., 1, :]
+    else:
+        qkv = gather_seq_matmul(x, params["wqkv"], params["bqkv"],
+                                axis_name=axis_name)
+        qkv = qkv.reshape(b, s, -1, 3, head_dim)
+        q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
+    if positions is not None:
+        q = apply_rope(q, positions)
+        k = apply_rope(k, positions)
+    ctx = _attend_local_heads(q, k, v, causal=causal, attn_impl=attn_impl,
+                              head_dim=head_dim)
+    ctx = ctx.reshape(b, s, -1)                              # (B, S, D/P)
+    return matmul_scatter_seq(ctx, params["wo"], params["bo"],
+                              axis_name=axis_name)
+
+
+def tp_block_sp(x, params, *, head_dim: int, axis_name: str,
+                causal: bool = True, attn_impl: str = "auto",
+                positions=None):
+    """Megatron-SP transformer block over SEQUENCE-sharded ``(B, S/P, D)``.
+
+    Same params/layout as :func:`tp_block`; LayerNorms and residuals are
+    per-position so they run on the local shard (1/P the replicated
+    compute), and all four cross-chip collectives (attention/MLP entry
+    gathers, exit reduce-scatters) ride the overlapped
+    ``collective_matmul`` rings.  Numerically equal to :func:`tp_block`
+    on the gathered sequence up to reassociation (tests pin it).
+    """
+    from .tensor_parallel import tp_mlp_sp
+
+    h = _layer_norm(x, params["ln1_scale"], params["ln1_bias"])
+    x = x + tp_attention_sp(h, params["attn"], head_dim=head_dim,
+                            axis_name=axis_name, causal=causal,
+                            attn_impl=attn_impl, positions=positions)
+    h = _layer_norm(x, params["ln2_scale"], params["ln2_bias"])
+    return x + tp_mlp_sp(h, params["mlp"], axis_name=axis_name)
 
 
 def vocab_parallel_logits_loss(h, table, targets, *, axis_name: str):

@@ -100,6 +100,10 @@ def _build_library() -> Optional[ctypes.CDLL]:
         _LIB.pfl_create.argtypes = [
             ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
             ctypes.c_int, ctypes.c_int]
+        _LIB.pfl_create_file.restype = ctypes.c_void_p
+        _LIB.pfl_create_file.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int, ctypes.c_int]
         _LIB.pfl_set_order.restype = ctypes.c_int
         _LIB.pfl_set_order.argtypes = [
             ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64), ctypes.c_int64]
@@ -150,6 +154,110 @@ class _Fields:
         return tuple(out) if len(out) > 1 else out[0]
 
 
+_META_NAME = "meta.json"
+_DATA_NAME = "data.bin"
+
+
+def write_file_dataset(path: str, arrays: Sequence[np.ndarray],
+                       chunk_records: int = 256) -> None:
+    """Materialize a dataset to disk in the prefetcher's record format.
+
+    Layout: ``path/data.bin`` holds N contiguous packed records (each
+    record = the concatenated raw bytes of every field's row — exactly
+    what the C++ workers pread into batch slots), ``path/meta.json``
+    holds shapes/dtypes.  Written in ``chunk_records`` blocks so an
+    ImageNet-scale dataset never needs 2× memory.
+
+    Reference frame: the on-disk stage the reference's
+    ``examples/imagenet/train_imagenet.py`` [uv] read via Chainer dataset
+    files + MultiprocessIterator; here the format is flat records because
+    the consumer is ``pread``-ing C++ threads, not worker processes.
+    """
+    import json
+
+    arrays = [np.ascontiguousarray(a) for a in arrays]
+    n = len(arrays[0])
+    if any(len(a) != n for a in arrays):
+        raise ValueError("all field arrays must share the leading dim")
+    os.makedirs(path, exist_ok=True)
+    meta = {
+        "version": 1,
+        "n_records": int(n),
+        "fields": [{"shape": list(a.shape[1:]), "dtype": str(a.dtype)}
+                   for a in arrays],
+    }
+    meta["record_bytes"] = int(sum(
+        int(np.prod(f["shape"], dtype=np.int64))
+        * np.dtype(f["dtype"]).itemsize for f in meta["fields"]))
+    with open(os.path.join(path, _DATA_NAME), "wb") as f:
+        for start in range(0, n, chunk_records):
+            stop = min(start + chunk_records, n)
+            rows = [a[start:stop].reshape(stop - start, -1).view(np.uint8)
+                    for a in arrays]
+            block = rows[0] if len(rows) == 1 else np.concatenate(rows, axis=1)
+            f.write(np.ascontiguousarray(block).tobytes())
+    tmp = os.path.join(path, f".{_META_NAME}.tmp{os.getpid()}")
+    with open(tmp, "w") as f:
+        json.dump(meta, f)
+    os.replace(tmp, os.path.join(path, _META_NAME))  # meta last = commit
+
+
+class FileDataset:
+    """A dataset materialized by :func:`write_file_dataset`.
+
+    Random access (``len`` / ``[i]`` → tuple of field rows) goes through a
+    lazy ``np.memmap``; the fast path is handing the WHOLE object to
+    :class:`PrefetchIterator`, whose C++ workers then ``pread`` batches
+    straight from the file without Python or the memmap in the loop.
+    """
+
+    def __init__(self, path: str):
+        import json
+
+        self.path = path
+        self.data_path = os.path.join(path, _DATA_NAME)
+        with open(os.path.join(path, _META_NAME)) as f:
+            meta = json.load(f)
+        if meta.get("version") != 1:
+            raise ValueError(f"unsupported dataset version {meta.get('version')}")
+        self.n_records = int(meta["n_records"])
+        self.record_bytes = int(meta["record_bytes"])
+        self.shapes = [tuple(f["shape"]) for f in meta["fields"]]
+        self.dtypes = [np.dtype(f["dtype"]) for f in meta["fields"]]
+        expect = self.n_records * self.record_bytes
+        actual = os.path.getsize(self.data_path)
+        if actual != expect:
+            raise ValueError(
+                f"{self.data_path}: size {actual} != n_records×record_bytes "
+                f"{expect} — truncated or foreign file")
+        self._mm = None
+
+    @property
+    def packed(self) -> np.ndarray:
+        if self._mm is None:
+            self._mm = np.memmap(self.data_path, dtype=np.uint8, mode="r",
+                                 shape=(self.n_records, self.record_bytes))
+        return self._mm
+
+    def unpack(self, raw: np.ndarray):
+        out, off = [], 0
+        for shape, dtype in zip(self.shapes, self.dtypes):
+            nbytes = int(np.prod(shape, dtype=np.int64)) * dtype.itemsize
+            fld = raw[:, off:off + nbytes].view(dtype).reshape(
+                (len(raw),) + tuple(shape))
+            out.append(fld)
+            off += nbytes
+        return tuple(out) if len(out) > 1 else out[0]
+
+    def __len__(self) -> int:
+        return self.n_records
+
+    def __getitem__(self, i: int):
+        row = self.unpack(np.asarray(self.packed[i:i + 1]))
+        return (tuple(f[0] for f in row) if isinstance(row, tuple)
+                else row[0])
+
+
 class PrefetchIterator:
     """Drop-in :class:`~chainermn_tpu.iterators.SerialIterator` analog with
     native prefetch: batches are (tuples of) stacked numpy arrays.
@@ -169,8 +277,15 @@ class PrefetchIterator:
                  shuffle: bool = True, seed: Optional[int] = None,
                  n_threads: int = 8, n_slots: int = 16,
                  copy: bool = False, use_native: Optional[bool] = None):
-        arrays = dataset if isinstance(dataset, (tuple, list)) else (dataset,)
-        self._fields = _Fields([np.asarray(a) for a in arrays])
+        file_backed = isinstance(dataset, FileDataset)
+        if file_backed:
+            # FileDataset quacks like _Fields (n_records/record_bytes/
+            # packed/unpack); the native handle preads from its data file.
+            self._fields = dataset
+        else:
+            arrays = (dataset if isinstance(dataset, (tuple, list))
+                      else (dataset,))
+            self._fields = _Fields([np.asarray(a) for a in arrays])
         self._copy = copy
         self._held = False  # consumer currently holds a slot (deferred release)
         self.batch_size = int(batch_size)
@@ -189,10 +304,16 @@ class PrefetchIterator:
         self._lib = lib
         self._handle = None
         if lib is not None:
-            self._handle = lib.pfl_create(
-                self._fields.packed.ctypes.data, self._fields.record_bytes,
-                self._fields.n_records, self.batch_size,
-                int(n_slots), int(n_threads))
+            if file_backed:
+                self._handle = lib.pfl_create_file(
+                    dataset.data_path.encode(), 0,
+                    self._fields.record_bytes, self._fields.n_records,
+                    self.batch_size, int(n_slots), int(n_threads))
+            else:
+                self._handle = lib.pfl_create(
+                    self._fields.packed.ctypes.data,
+                    self._fields.record_bytes, self._fields.n_records,
+                    self.batch_size, int(n_slots), int(n_threads))
             if self._handle:
                 self._push_stream()
 
@@ -293,6 +414,11 @@ class PrefetchIterator:
         self._release_held()
         out = ctypes.c_void_p()
         b = self._lib.pfl_acquire(self._handle, ctypes.byref(out))
+        if b == -3:
+            raise RuntimeError(
+                "prefetcher disk read failed (file truncated/removed or "
+                "I/O error mid-stream); the stream is poisoned — recreate "
+                "the iterator after fixing the data file")
         if b < 0:
             raise RuntimeError(f"prefetcher stream desync (code {b})")
         self._held = True
@@ -352,4 +478,5 @@ class PrefetchIterator:
             pass
 
 
-__all__ = ["PrefetchIterator", "native_available"]
+__all__ = ["FileDataset", "PrefetchIterator", "native_available",
+           "write_file_dataset"]

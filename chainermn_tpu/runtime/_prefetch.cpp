@@ -19,6 +19,9 @@
 // Build: g++ -O3 -std=c++17 -shared -fPIC -pthread (see runtime/__init__.py
 // :: _build_library).
 
+#include <fcntl.h>
+#include <unistd.h>
+
 #include <condition_variable>
 #include <cstdint>
 #include <cstring>
@@ -35,7 +38,10 @@ struct Slot {
 };
 
 struct Loader {
-  const uint8_t* data;
+  const uint8_t* data;     // in-memory mode (null in file mode)
+  int fd = -1;             // file mode: records pread() from data_offset
+  int64_t data_offset = 0;
+  bool io_error = false;   // sticky; surfaced via pfl_acquire() == -3
   int64_t record_bytes, n_records, batch_size;
   int n_slots;
 
@@ -57,13 +63,28 @@ struct Loader {
   int filling = 0;  // workers currently copying outside the lock
   std::vector<std::thread> workers;
 
-  void fill(int64_t b, Slot& slot) {
+  // Returns false on I/O failure (file mode only); the caller marks the
+  // loader poisoned rather than publishing a half-filled batch.
+  bool fill(int64_t b, Slot& slot) {
     const int64_t* idx = order.data() + b * batch_size;
     for (int64_t r = 0; r < batch_size; ++r) {
-      std::memcpy(slot.buf.data() + r * record_bytes,
-                  data + idx[r] * record_bytes,
-                  static_cast<size_t>(record_bytes));
+      uint8_t* dst = slot.buf.data() + r * record_bytes;
+      if (fd >= 0) {
+        int64_t off = data_offset + idx[r] * record_bytes;
+        int64_t done = 0;
+        while (done < record_bytes) {
+          ssize_t got = pread(fd, dst + done,
+                              static_cast<size_t>(record_bytes - done),
+                              static_cast<off_t>(off + done));
+          if (got <= 0) return false;  // EOF mid-record or read error
+          done += got;
+        }
+      } else {
+        std::memcpy(dst, data + idx[r] * record_bytes,
+                    static_cast<size_t>(record_bytes));
+      }
     }
+    return true;
   }
 
   void work() {
@@ -87,10 +108,11 @@ struct Loader {
       slot.batch = -1;  // mark "filling"
       ++filling;
       lk.unlock();
-      fill(b, slot);    // the GIL-free hot copy, outside the lock
+      bool ok = fill(b, slot);  // the GIL-free hot copy, outside the lock
       lk.lock();
       --filling;
-      if (gen == g) slot.batch = b;  // publish only into the same stream
+      if (!ok) io_error = true;          // poison: consumer sees -3
+      else if (gen == g) slot.batch = b; // publish only into the same stream
       cv_batch_ready.notify_all();
     }
   }
@@ -106,6 +128,33 @@ void* pfl_create(const void* data, int64_t record_bytes, int64_t n_records,
     return nullptr;
   auto* L = new Loader();
   L->data = static_cast<const uint8_t*>(data);
+  L->record_bytes = record_bytes;
+  L->n_records = n_records;
+  L->batch_size = batch_size;
+  L->n_slots = n_slots;
+  L->slots.resize(n_slots);
+  for (auto& s : L->slots)
+    s.buf.resize(static_cast<size_t>(batch_size * record_bytes));
+  for (int i = 0; i < n_threads; ++i)
+    L->workers.emplace_back([L] { L->work(); });
+  return L;
+}
+
+// File-backed variant: records live in `path` starting at `data_offset`
+// (raw packed rows, the layout write_file_dataset emits); worker threads
+// pread() them straight into batch slots — the disk analog of the
+// reference's MultiprocessIterator feeding ImageNet from local storage.
+void* pfl_create_file(const char* path, int64_t data_offset,
+                      int64_t record_bytes, int64_t n_records,
+                      int64_t batch_size, int n_slots, int n_threads) {
+  if (record_bytes <= 0 || batch_size <= 0 || n_slots < 2 || n_threads < 1)
+    return nullptr;
+  int fd = open(path, O_RDONLY);
+  if (fd < 0) return nullptr;
+  auto* L = new Loader();
+  L->data = nullptr;
+  L->fd = fd;
+  L->data_offset = data_offset;
   L->record_bytes = record_bytes;
   L->n_records = n_records;
   L->batch_size = batch_size;
@@ -163,7 +212,9 @@ int64_t pfl_acquire(void* h, void** out) {
   if (L->next_consume >= L->n_batches) return -1;
   int64_t b = L->next_consume;
   Slot& slot = L->slots[b % L->n_slots];
-  while (!L->stop && slot.batch != b) L->cv_batch_ready.wait(lk);
+  while (!L->stop && !L->io_error && slot.batch != b)
+    L->cv_batch_ready.wait(lk);
+  if (L->io_error) return -3;  // disk read failed; stream is poisoned
   if (L->stop) return -1;
   L->acquired = b % L->n_slots;
   *out = slot.buf.data();
@@ -191,6 +242,7 @@ void pfl_destroy(void* h) {
   L->cv_slot_free.notify_all();
   L->cv_batch_ready.notify_all();
   for (auto& t : L->workers) t.join();
+  if (L->fd >= 0) close(L->fd);
   delete L;
 }
 

@@ -188,6 +188,7 @@ def make_flax_train_step(
     axis_name: str = DEFAULT_AXIS_NAME,
     donate: bool = True,
     allreduce_grad_dtype=None,
+    grad_reduce: Optional[Callable] = None,
 ):
     """Train step for flax modules with mutable ``batch_stats`` (BatchNorm).
 
@@ -199,6 +200,10 @@ def make_flax_train_step(
     ``AllreducePersistent`` keeping eval-time BN consistent
     (extensions/allreduce_persistent.py [uv]) — but continuously, not as a
     pre-eval extension.
+
+    ``grad_reduce``: custom wire collective replacing the default pmean —
+    e.g. ``ops.collective.hierarchical_pmean`` for the two-tier ICI×DCN
+    mean over a multislice mesh (see :func:`_value_and_global_grads`).
     """
     if mesh is None:
         mesh = make_mesh(axis_name=axis_name)
@@ -215,7 +220,8 @@ def make_flax_train_step(
             return loss, (mutated, metrics)
 
         (loss, (mutated, metrics)), grads = _value_and_global_grads(
-            local_loss, params, axis_name, allreduce_grad_dtype)
+            local_loss, params, axis_name, allreduce_grad_dtype,
+            grad_reduce=grad_reduce)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         new_stats = jax.lax.pmean(mutated["batch_stats"], axis_name)

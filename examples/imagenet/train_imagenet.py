@@ -34,6 +34,10 @@ def main():
     parser.add_argument("--batchsize", type=int, default=64, help="per-chip batch")
     parser.add_argument("--dataset-size", type=int, default=512,
                         help="synthetic records held in the prefetch buffer")
+    parser.add_argument("--data-dir", default=None,
+                        help="train from an on-disk record dataset "
+                             "(write_file_dataset layout); materialized "
+                             "with synthetic records if absent")
     parser.add_argument("--steps", type=int, default=30)
     parser.add_argument("--image-size", type=int, default=224)
     parser.add_argument("--num-classes", type=int, default=1000)
@@ -160,17 +164,38 @@ def main():
 
     # Input pipeline: the native C++ prefetcher assembles batches in worker
     # threads (GIL-free) while the previous step computes — the reference's
-    # MultiprocessIterator role (SURVEY.md §2.9).  Synthetic records stand in
-    # for decoded ImageNet when /imagenet is absent; the data PATH (record
-    # buffer → prefetch ring → device_put per step) is the real one.
+    # MultiprocessIterator role (SURVEY.md §2.9).  With --data-dir the
+    # records come OFF DISK (pread-ing C++ workers; the reference example's
+    # defining job); otherwise synthetic in-memory records run the identical
+    # path.  An empty/missing --data-dir is materialized first, standing in
+    # for an ImageNet conversion step when /imagenet is absent.
     data_rng = np.random.RandomState(0)
     n_records = max(args.dataset_size, global_batch)
-    records = data_rng.randn(n_records, args.image_size, args.image_size, 3
-                             ).astype(np.float32)
-    labels = data_rng.randint(0, args.num_classes, n_records).astype(np.int32)
+    if args.data_dir:
+        meta = os.path.join(args.data_dir, "meta.json")
+        # Rank 0 alone decides whether to materialize (a per-rank exists()
+        # check would race with the write and leave ranks disagreeing on
+        # whether to enter the barrier); the bcast is UNCONDITIONAL so it
+        # is the same collective on every process.
+        if comm.owns_rank(0) and not os.path.exists(meta):
+            records = data_rng.randn(
+                n_records, args.image_size, args.image_size, 3
+            ).astype(np.float32)
+            labels = data_rng.randint(
+                0, args.num_classes, n_records).astype(np.int32)
+            mn.write_file_dataset(args.data_dir, [records, labels])
+            print(f"materialized {n_records} records to {args.data_dir}")
+        comm.bcast_obj(None)  # barrier: dataset visible before readers
+        dataset = mn.FileDataset(args.data_dir)
+    else:
+        records = data_rng.randn(n_records, args.image_size, args.image_size,
+                                 3).astype(np.float32)
+        labels = data_rng.randint(0, args.num_classes, n_records
+                                  ).astype(np.int32)
+        dataset = (records, labels)
     # copy=True: device_put is async on real chips, and without the copy the
     # prefetch ring could recycle the slot under a still-running H2D DMA.
-    it = mn.PrefetchIterator((records, labels), batch_size=global_batch,
+    it = mn.PrefetchIterator(dataset, batch_size=global_batch,
                              shuffle=True, seed=1, copy=True)
     if comm.rank == 0 and not mn.runtime.native_available():
         print("note: native prefetcher unavailable, python fallback in use")

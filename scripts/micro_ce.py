@@ -1,0 +1,87 @@
+#!/usr/bin/env python
+"""Vocab-CE strategies on the real chip: can the (B,S,V) fp32 logits
+materialization be avoided?
+
+Candidates at the bench shape (B=8, S=1024, D=1024, V=32768, bf16 h/table):
+  baseline   — fp32 logits einsum, max/exp/sum/pick (what the LM runs)
+  chunked    — lax.map over S-chunks with jax.checkpoint (remat logits)
+  bf16logits — materialize logits in bf16, stats in fp32 (halved traffic)
+All fwd+bwd (value_and_grad wrt h and table), scan-chained, RTT-corrected.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+B, S, D, V = 8, 1024, 1024, 32768
+PEAK = 197e12
+N = 60
+FLOPS = 2 * B * S * D * V * 3  # fwd + 2x bwd matmuls
+
+
+def bench(tag, loss_fn):
+    rs = np.random.RandomState(0)
+    h0 = jax.device_put(rs.randn(B, S, D).astype(jnp.bfloat16))
+    tab = jax.device_put(rs.randn(V, D).astype(jnp.bfloat16))
+    tgt = jax.device_put(rs.randint(0, V, (B, S)).astype(np.int32))
+
+    @jax.jit
+    def run(h, table):
+        def body(c, _):
+            l, (dh, dt) = jax.value_and_grad(loss_fn, argnums=(0, 1))(
+                c, table, tgt)
+            return (c + dh.astype(c.dtype) * 0.0 + l * 0.0).astype(c.dtype), l
+        fin, ls = jax.lax.scan(body, h, None, length=N)
+        return ls[-1] + jnp.max(fin).astype(jnp.float32) * 0.0
+
+    float(run(h0, tab))
+    best = 1e9
+    for _ in range(3):
+        t0 = time.perf_counter()
+        float(run(h0, tab))
+        best = min(best, (time.perf_counter() - t0 - 0.1) / N)
+    print(f"{tag}: {best*1e3:.2f} ms  mfu={FLOPS/best/PEAK:.3f}", flush=True)
+
+
+def baseline(h, table, tgt):
+    logits = jnp.einsum("bsd,vd->bsv", h, table,
+                        preferred_element_type=jnp.float32)
+    m = jax.lax.stop_gradient(logits).max(-1)
+    se = jnp.exp(logits - m[..., None]).sum(-1)
+    picked = jnp.take_along_axis(logits, tgt[..., None], -1)[..., 0]
+    return jnp.mean(m + jnp.log(se) - picked)
+
+
+def chunked(h, table, tgt, chunk=128):
+    def one(args):
+        hh, tt = args
+        logits = jnp.einsum("bsd,vd->bsv", hh, table,
+                            preferred_element_type=jnp.float32)
+        m = jax.lax.stop_gradient(logits).max(-1)
+        se = jnp.exp(logits - m[..., None]).sum(-1)
+        picked = jnp.take_along_axis(logits, tt[..., None], -1)[..., 0]
+        return (m + jnp.log(se) - picked).sum()
+
+    hs = h.reshape(B, S // chunk, chunk, D).transpose(1, 0, 2, 3)
+    ts = tgt.reshape(B, S // chunk, chunk).transpose(1, 0, 2)
+    parts = jax.lax.map(jax.checkpoint(one), (hs, ts))
+    return parts.sum() / (B * S)
+
+
+def bf16logits(h, table, tgt):
+    logits = jnp.einsum("bsd,vd->bsv", h, table,
+                        preferred_element_type=jnp.float32).astype(jnp.bfloat16)
+    lf = logits.astype(jnp.float32)
+    m = jax.lax.stop_gradient(lf).max(-1)
+    se = jnp.exp(lf - m[..., None]).sum(-1)
+    picked = jnp.take_along_axis(lf, tgt[..., None], -1)[..., 0]
+    return jnp.mean(m + jnp.log(se) - picked)
+
+
+if __name__ == "__main__":
+    bench("baseline_fp32_logits", baseline)
+    bench("chunked_remat_c128", lambda h, t, g: chunked(h, t, g, 128))
+    bench("chunked_remat_c256", lambda h, t, g: chunked(h, t, g, 256))
+    bench("bf16_logits", bf16logits)
