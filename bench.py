@@ -175,13 +175,16 @@ def measure(step, variables, opt_state, batch, steps):
     return dt, out
 
 
-def bench_transformer_lm(n_chips_hint=None):
+def bench_transformer_lm(n_chips_hint=None, seq=1024, per_chip_batch=8,
+                         pos_impl="learned"):
     """Tokens/sec/chip + MFU for a TP transformer LM with flash attention.
 
     The FLOPs-dense half of the perf story: ResNet-50's conv shapes cap its
     MFU well below what the MXU sustains on big matmuls; a decoder LM shows
     the framework's ceiling.  Runs DP×TP over a (n_chips, 1) mesh via the
-    same make_hybrid_shard_map_step users call.
+    same make_hybrid_shard_map_step users call.  The long-context section
+    re-runs it at ``seq=4096`` — same honesty layer (analytic fallback,
+    suspect flag) for both.
     """
     import jax
     import jax.numpy as jnp
@@ -195,13 +198,12 @@ def bench_transformer_lm(n_chips_hint=None):
     from functools import partial
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    vocab, d_model, n_heads, n_layers, seq = 32768, 1024, 16, 8, 1024
+    vocab, d_model, n_heads, n_layers = 32768, 1024, 16, 8
     n_chips = len(jax.devices())
-    per_chip_batch = 8
     mesh = mn.make_nd_mesh(("data", "model"), (n_chips, 1))
     params = init_tp_transformer_lm(
         jax.random.PRNGKey(0), vocab, d_model, n_heads, n_layers,
-        max_len=seq, dtype=jnp.bfloat16)
+        max_len=seq, dtype=jnp.bfloat16, pos_impl=pos_impl)
     specs = transformer_lm_specs(params, "model")
     loss_fn = partial(tp_transformer_lm_loss, head_dim=d_model // n_heads,
                       axis_name="model", attn_impl="flash")
@@ -251,8 +253,67 @@ def bench_transformer_lm(n_chips_hint=None):
         "flops_source": flops_source,
         "n_params": int(n_params),
         "config": f"d{d_model} L{n_layers} h{n_heads} S{seq} V{vocab} "
-                  f"b{per_chip_batch}/chip bf16 flash",
+                  f"b{per_chip_batch}/chip bf16 flash {pos_impl}",
     }
+
+
+def bench_long_context():
+    """Long-sequence numbers: the flash kernel pair at S=8k/16k (attention
+    is the whole story there) and a full LM train step at S=4096.
+
+    Attention MFU is against the causal-attention FLOPs only — the number
+    that shows whether the Pallas fwd+bwd kernels hold up when the O(S²)
+    term dominates (the round-2 XLA-scan backward degraded here: it cannot
+    skip above-diagonal blocks)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    dev = jax.devices()[0]
+    peak = peak_flops_for(dev.device_kind)
+    out = {}
+    rs = np.random.RandomState(0)
+    H, HD = 16, 64
+
+    for S, B, reps in ((8192, 2, 20), (16384, 1, 12)):
+        q = jax.device_put(rs.randn(B, S, H, HD).astype(jnp.bfloat16))
+        flops = 2 * 2 * B * H * S * S * HD / 2 * 3.5  # causal fwd+bwd
+
+        from chainermn_tpu.ops.flash_attention import flash_attention
+
+        @jax.jit
+        def chain(qq):
+            def body(c, _):
+                o, vjp = jax.vjp(
+                    lambda a: flash_attention(a, a, a, causal=True), c)
+                (dq,) = vjp(o)
+                return dq.astype(c.dtype), None
+            fin, _ = jax.lax.scan(body, qq, None, length=reps)
+            return jnp.max(fin).astype(jnp.float32)
+
+        float(chain(q))
+        best = float("inf")
+        for _ in range(2):
+            t0 = time.perf_counter()
+            float(chain(q))
+            best = min(best, (time.perf_counter() - t0 - 0.1) / reps)
+        best = max(best, 1e-4)  # RTT subtraction must not negate a fast run
+        mfu = flops / best / peak if peak else None
+        if mfu and mfu > 1.0:
+            print(f"bench: WARNING long-context S={S} attention MFU "
+                  f"{mfu:.2f} > 1.0 impossible — number not credible",
+                  file=sys.stderr)
+        out[f"flash_fwd_bwd_S{S}"] = {
+            "ms": round(best * 1e3, 2),
+            "attn_mfu": round(mfu, 3) if mfu else None,
+            "suspect": bool(mfu and mfu > 1.0),
+        }
+
+    # full LM step at S=4096 (b=2: same 8192 tokens/step as the headline)
+    # — same builder and honesty layer as the headline transformer section.
+    out["lm_S4096"] = bench_transformer_lm(seq=4096, per_chip_batch=2,
+                                           pos_impl="rope")
+    return out
 
 
 def bench_data_path():
@@ -748,6 +809,15 @@ def main():
         except Exception as e:
             print(f"bench: data-path section failed: {e!r}", file=sys.stderr)
 
+    # --- long context: flash kernels at 8k/16k + LM step at 4096 -----------
+    long_context = None
+    if on_tpu:
+        try:
+            long_context = bench_long_context()
+        except Exception as e:
+            print(f"bench: long-context section failed: {e!r}",
+                  file=sys.stderr)
+
     # --- projected pod-scale DP efficiency (measured step + spec ICI) ------
     projected = None
     if on_tpu:
@@ -780,6 +850,7 @@ def main():
         "transformer_lm": transformer,
         "decode": decode,
         "data_path": data_path,
+        "long_context": long_context,
         "projected_scaling": projected,
         "scaling": scaling,
     }))

@@ -25,3 +25,8 @@ def devices():
     devs = jax.devices()
     assert len(devs) == 8, f"expected 8 virtual CPU devices, got {devs}"
     return devs
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: subprocess-spawning tests (larger virtual meshes)")
