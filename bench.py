@@ -653,6 +653,17 @@ def main():
         scaling_worker(args.scaling_worker, args.allreduce_grad_dtype)
         return
 
+    # The one JSON line prints only at the END — if a driver-side timeout
+    # kills a long run mid-way, everything is lost.  Optional sections
+    # therefore respect a wall-clock budget (the headline + transformer
+    # always run): when exceeded, later sections are skipped with a note
+    # and the scaling sweep drops its slow tail.
+    t_start = time.time()
+    budget_s = float(os.environ.get("BENCH_BUDGET_S", 2400))
+
+    def over_budget():
+        return time.time() - t_start > budget_s
+
     import jax
 
     if os.environ.get("JAX_PLATFORMS") == "cpu":
@@ -795,28 +806,36 @@ def main():
 
     # --- decode: generation perf over the KV cache -------------------------
     decode = None
-    if on_tpu:
+    if on_tpu and not over_budget():
         try:
             decode = bench_decode()
         except Exception as e:
             print(f"bench: decode section failed: {e!r}", file=sys.stderr)
+    elif on_tpu:
+        print("bench: over budget — decode section skipped", file=sys.stderr)
 
     # --- input pipeline: disk-fed vs synthetic -----------------------------
     data_path = None
-    if on_tpu:
+    if on_tpu and not over_budget():
         try:
             data_path = bench_data_path()
         except Exception as e:
             print(f"bench: data-path section failed: {e!r}", file=sys.stderr)
+    elif on_tpu:
+        print("bench: over budget — data-path section skipped",
+              file=sys.stderr)
 
     # --- long context: flash kernels at 8k/16k + LM step at 4096 -----------
     long_context = None
-    if on_tpu:
+    if on_tpu and not over_budget():
         try:
             long_context = bench_long_context()
         except Exception as e:
             print(f"bench: long-context section failed: {e!r}",
                   file=sys.stderr)
+    elif on_tpu:
+        print("bench: over budget — long-context section skipped",
+              file=sys.stderr)
 
     # --- projected pod-scale DP efficiency (measured step + spec ICI) ------
     projected = None
@@ -830,7 +849,13 @@ def main():
         }
 
     # --- DP weak-scaling sweep (virtual CPU mesh, fresh subprocesses) ------
-    scaling = None if args.skip_scaling else run_scaling_sweep()
+    scaling = None
+    if not args.skip_scaling:
+        ns = (1, 2, 4, 8) if over_budget() else (1, 2, 4, 8, 16, 32)
+        if len(ns) == 4:
+            print("bench: over budget — scaling sweep drops n=16/32",
+                  file=sys.stderr)
+        scaling = run_scaling_sweep(ns)
 
     print(json.dumps({
         "metric": "resnet50_images_per_sec_per_chip",

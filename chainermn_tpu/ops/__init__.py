@@ -1,4 +1,5 @@
 from .flash_attention import flash_attention  # noqa: F401
+from .fused_ce import ce_grads, ce_stats, fused_cross_entropy  # noqa: F401
 from .collective import (  # noqa: F401
     all_gather,
     all_to_all,

@@ -219,7 +219,53 @@ def tp_block_sp(x, params, *, head_dim: int, axis_name: str,
     return x + tp_mlp_sp(h, params["mlp"], axis_name=axis_name)
 
 
-def vocab_parallel_logits_loss(h, table, targets, *, axis_name: str):
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _fused_vp_nll(h2, table, local_t, axis_name, explicit_psum):
+    """Per-row NLL via the fused-CE kernels with VOCAB-SHARDED tables:
+    shard-local online stats, pmax/psum combine, global-LSE backward.
+    ``h2 (T, D)``, ``table (V/P, D)``, ``local_t (T,)`` already shifted to
+    this shard's range (out-of-range ids match nothing — exactly the
+    one-hot masking the kernels implement).
+
+    ``explicit_psum``: True when vma tracking is OFF (``check_vma=False``
+    contexts) — the backward then hand-psums dh over ``axis_name``; with
+    tracking on, the caller's pcast promotions route every cross-shard
+    gradient reduction through their transposes instead."""
+    return _fused_vp_nll_fwd(h2, table, local_t, axis_name, explicit_psum)[0]
+
+
+def _fused_vp_nll_fwd(h2, table, local_t, axis_name, explicit_psum):
+    from ..ops.fused_ce import ce_stats
+
+    m, l, p = ce_stats(h2, table, local_t)
+    gm = jax.lax.pmax(m, axis_name)
+    gl = jax.lax.psum(l * jnp.exp(m - gm), axis_name)
+    lse = gm + jnp.log(gl)
+    picked = jax.lax.psum(p, axis_name)  # owner shard contributes; rest 0
+    return lse - picked, (h2, table, local_t, lse)
+
+
+def _fused_vp_nll_bwd(axis_name, explicit_psum, res, dnll):
+    from ..ops.fused_ce import ce_grads
+
+    h2, table, local_t, lse = res
+    dh, dtable = ce_grads(h2, table, local_t, lse, dnll)
+    if explicit_psum:
+        dh = jax.lax.psum(dh.astype(jnp.float32), axis_name).astype(h2.dtype)
+    return dh, dtable, None
+
+
+_fused_vp_nll.defvjp(_fused_vp_nll_fwd, _fused_vp_nll_bwd)
+
+# Auto threshold: switch to the fused kernels when the materialized local
+# logits would exceed this many bytes (the XLA path stops COMPILING around
+# HBM size — measured on v5e it still runs, faster, at 8.6 GB and fails at
+# 34 GB; see docs/PERF.md).
+_FUSED_CE_AUTO_BYTES = 8 << 30
+
+
+def vocab_parallel_logits_loss(h, table, targets, *, axis_name: str,
+                               ce_impl: str = "auto"):
     """Cross-entropy from VOCAB-SHARDED logits — ``(B, S, V)`` never
     materializes unsharded.
 
@@ -227,9 +273,50 @@ def vocab_parallel_logits_loss(h, table, targets, *, axis_name: str):
     shard of the (tied) embedding; ``targets (B, S)`` global token ids.
     Three cheap collectives: pmax (stable shift), psum of the local
     exp-sum, psum of the target-logit one-hot pick.
+
+    ``ce_impl``: ``'xla'`` materializes the local ``(B, S, V/P)`` fp32
+    logits (fastest when they fit — XLA runs this chain at ~0.8 MFU);
+    ``'fused'`` runs the Pallas online-softmax kernels
+    (``ops.fused_ce``) — logits tiles never leave VMEM, O(B·S) memory,
+    the only path that COMPILES at huge ``T×V`` (docs/PERF.md records
+    the 34 GB-logits case); ``'auto'`` picks fused on TPU once the local
+    logits buffer would cross ~8 GB (below that XLA is measurably
+    faster), xla otherwise.
     """
     vocab_per = table.shape[0]
     start = jax.lax.axis_index(axis_name) * vocab_per
+    b, s, d = h.shape
+    if ce_impl == "auto":
+        big = b * s * vocab_per * 4 > _FUSED_CE_AUTO_BYTES
+        on_tpu = jax.default_backend() == "tpu"
+        aligned = (b * s) % 8 == 0 and vocab_per % 8 == 0
+        ce_impl = "fused" if (big and on_tpu and aligned) else "xla"
+    if ce_impl == "fused":
+        h2 = h.reshape(b * s, d)
+        # The custom_vjp replaces AD's transpose, so every cross-shard
+        # gradient reduction must come from varying-axis promotions
+        # OUTSIDE it: promote BOTH operands to the union of their varying
+        # axes (h gains the model axis, table gains the data axis under
+        # DP×TP) — each promotion's transpose then psums the matching
+        # cotangent (dh over model, dtable over data) exactly where the
+        # bypassed machinery would have.  When vma tracking is off
+        # (check_vma=False contexts) there is nothing to promote; the
+        # backward hand-psums dh over the model axis instead.
+        hv = set(getattr(jax.typeof(h2), "vma", frozenset()))
+        tv = set(getattr(jax.typeof(table), "vma", frozenset()))
+        vma_active = bool(hv or tv)
+        if vma_active:
+            union = hv | tv | {axis_name}
+            for ax in sorted(union - hv):
+                h2 = jax.lax.pcast(h2, ax, to="varying")
+            for ax in sorted(union - tv):
+                table = jax.lax.pcast(table, ax, to="varying")
+        local_t = (targets - start).reshape(-1)
+        nll = _fused_vp_nll(h2, table, local_t, axis_name, not vma_active)
+        return jnp.mean(nll)
+    if ce_impl != "xla":
+        raise ValueError(
+            f"ce_impl must be 'auto', 'xla' or 'fused', got {ce_impl!r}")
     logits = jnp.einsum("bsd,vd->bsv", h, table,
                         preferred_element_type=jnp.float32)  # (B, S, V/P)
 
@@ -248,12 +335,14 @@ def vocab_parallel_logits_loss(h, table, targets, *, axis_name: str):
 
 
 def tp_transformer_lm_loss(params, batch, *, head_dim: int, axis_name: str,
-                           causal: bool = True, attn_impl: str = "auto"):
+                           causal: bool = True, attn_impl: str = "auto",
+                           ce_impl: str = "auto"):
     """Per-token mean NLL of a decoder-only LM over the LOCAL batch shard.
 
     ``batch``: ``(tokens (B, S+1) int32,)`` — inputs are ``[:, :-1]``,
     targets ``[:, 1:]``.  Feed to ``make_hybrid_shard_map_step`` for DP×TP
-    (``functools.partial`` the static args first).
+    (``functools.partial`` the static args first).  ``ce_impl`` selects
+    the loss path (see :func:`vocab_parallel_logits_loss`).
     """
     tokens = batch[0]
     inputs, targets = tokens[:, :-1], tokens[:, 1:]
@@ -271,7 +360,7 @@ def tp_transformer_lm_loss(params, batch, *, head_dim: int, axis_name: str,
                      causal=causal, attn_impl=attn_impl, positions=positions)
     x = _layer_norm(x, params["lnf_scale"], params["lnf_bias"])
     return vocab_parallel_logits_loss(x, params["embed"], targets,
-                                      axis_name=axis_name)
+                                      axis_name=axis_name, ce_impl=ce_impl)
 
 
 def sp_block(x, params, *, head_dim: int, axis_name: str, causal: bool = True,
