@@ -176,7 +176,7 @@ def measure(step, variables, opt_state, batch, steps):
 
 
 def bench_transformer_lm(n_chips_hint=None, seq=1024, per_chip_batch=8,
-                         pos_impl="learned"):
+                         pos_impl="learned", d_model=1024, n_layers=8):
     """Tokens/sec/chip + MFU for a TP transformer LM with flash attention.
 
     The FLOPs-dense half of the perf story: ResNet-50's conv shapes cap its
@@ -198,7 +198,7 @@ def bench_transformer_lm(n_chips_hint=None, seq=1024, per_chip_batch=8,
     from functools import partial
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    vocab, d_model, n_heads, n_layers = 32768, 1024, 16, 8
+    vocab, n_heads = 32768, 16
     n_chips = len(jax.devices())
     mesh = mn.make_nd_mesh(("data", "model"), (n_chips, 1))
     params = init_tp_transformer_lm(
@@ -794,6 +794,7 @@ def main():
 
     # --- transformer LM: the FLOPs-dense half of the perf story ------------
     transformer = None
+    transformer_large = None
     if on_tpu:
         try:
             transformer = bench_transformer_lm()
@@ -803,6 +804,15 @@ def main():
             suspect = suspect or bool(transformer.get("suspect"))
         except Exception as e:
             print(f"bench: transformer section failed: {e!r}", file=sys.stderr)
+        try:
+            # 875M params: the matmul-dominated ceiling (0.72 compiled /
+            # 0.77 useful MFU measured on v5e — docs/PERF.md)
+            transformer_large = bench_transformer_lm(
+                per_chip_batch=4, d_model=2048, n_layers=16)
+            suspect = suspect or bool(transformer_large.get("suspect"))
+        except Exception as e:
+            print(f"bench: large-transformer section failed: {e!r}",
+                  file=sys.stderr)
 
     # --- decode: generation perf over the KV cache -------------------------
     decode = None
@@ -873,6 +883,7 @@ def main():
         "allreduce_grad_dtype": args.allreduce_grad_dtype,
         "batch_sweep": batch_sweep,
         "transformer_lm": transformer,
+        "transformer_lm_large": transformer_large,
         "decode": decode,
         "data_path": data_path,
         "long_context": long_context,

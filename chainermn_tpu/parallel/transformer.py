@@ -258,9 +258,13 @@ def _fused_vp_nll_bwd(axis_name, explicit_psum, res, dnll):
 _fused_vp_nll.defvjp(_fused_vp_nll_fwd, _fused_vp_nll_bwd)
 
 # Auto threshold: switch to the fused kernels when the materialized local
-# logits would exceed this many bytes (the XLA path stops COMPILING around
-# HBM size — measured on v5e it still runs, faster, at 8.6 GB and fails at
-# 34 GB; see docs/PERF.md).
+# logits would exceed this many bytes.  Deliberately conservative vs the
+# measured standalone crossover (on v5e the XLA path still ran, ~40%
+# faster, at 8.6 GB of logits and failed at 34 GB — docs/PERF.md): a
+# FULL train step also holds params/activations/optimizer state, so
+# 'auto' must flip while the logits still leave that headroom; prefer a
+# few ms of CE time over an OOM at compile.  Force ce_impl='xla' to keep
+# the materializing path near the boundary.
 _FUSED_CE_AUTO_BYTES = 8 << 30
 
 
