@@ -37,7 +37,12 @@ def main():
     parser.add_argument("--batchsize", type=int, default=32, help="global batch")
     parser.add_argument("--steps", type=int, default=60)
     parser.add_argument("--lr", type=float, default=1e-2)
-    parser.add_argument("--attn-impl", default="xla", choices=["xla", "flash"])
+    parser.add_argument("--attn-impl", default="auto",
+                        choices=["auto", "xla", "flash"])
+    parser.add_argument("--ce-impl", default="auto",
+                        choices=["auto", "xla", "fused"],
+                        help="LM-head loss path; 'fused' = the Pallas "
+                             "online-softmax kernels (big-vocab heads)")
     args = parser.parse_args()
 
     if args.devices:
@@ -73,7 +78,8 @@ def main():
     optimizer = optax.adam(args.lr)
     loss_fn = partial(tp_transformer_lm_loss,
                       head_dim=args.d_model // args.n_heads,
-                      axis_name="model", attn_impl=args.attn_impl)
+                      axis_name="model", attn_impl=args.attn_impl,
+                      ce_impl=args.ce_impl)
 
     step = make_hybrid_shard_map_step(loss_fn, optimizer, mesh, params, specs)
     p = shard_pytree(params, mesh, specs)
