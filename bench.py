@@ -82,7 +82,8 @@ def hbm_bw_for(device_kind: str):
     return None
 
 
-def build_step(arch, image_size, per_chip_batch, allreduce_grad_dtype=None):
+def build_step(arch, image_size, per_chip_batch, allreduce_grad_dtype=None,
+               double_buffering=False):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -104,7 +105,8 @@ def build_step(arch, image_size, per_chip_batch, allreduce_grad_dtype=None):
     optimizer = mn.create_multi_node_optimizer(
         optax.chain(optax.add_decayed_weights(1e-4),
                     optax.sgd(0.1, momentum=0.9)),
-        comm, allreduce_grad_dtype=allreduce_grad_dtype)
+        comm, allreduce_grad_dtype=allreduce_grad_dtype,
+        double_buffering=double_buffering)
 
     def loss_and_metrics(logits, batch):
         return cross_entropy_loss(logits, batch[1]), {}
@@ -463,7 +465,7 @@ def bench_decode():
     }
 
 
-def scaling_worker(n, grad_dtype=None):
+def scaling_worker(n, grad_dtype=None, double_buffering=False):
     """Subprocess body: weak-scaling point on an n-device virtual CPU mesh.
 
     Besides the train-step throughput, directly times the gradient-sized
@@ -479,7 +481,8 @@ def scaling_worker(n, grad_dtype=None):
     # in-process override before backend init is authoritative.
     jax.config.update("jax_platforms", "cpu")
     step, variables, opt_state, batch, n_chips, global_batch = build_step(
-        "resnet18", 32, 8, allreduce_grad_dtype=grad_dtype)
+        "resnet18", 32, 8, allreduce_grad_dtype=grad_dtype,
+        double_buffering=double_buffering)
     assert n_chips == n, (n_chips, n)
     steps = 3 if n <= 8 else 2
     dt, _ = measure(step, variables, opt_state, batch, steps=steps)
@@ -521,21 +524,25 @@ def run_scaling_sweep(ns=(1, 2, 4, 8, 16, 32)):
     """Weak-scaling sweep in fresh CPU subprocesses (platform is per-process).
 
     Reports per-point efficiency vs n=1 and the measured gradient-pmean
-    time, plus one COMPRESSED point (bf16 wire) at n=8 so the
-    ``allreduce_grad_dtype`` feature finally has a recorded number
-    (reference frame: the v1.2 double-buffering/fp16-allreduce headline,
-    SURVEY.md §6)."""
-    def run_point(n, grad_dtype=None):
+    time, plus two extra n=8 points so the reference's v1.2 headline
+    features (SURVEY.md §6) each have a recorded number: a COMPRESSED
+    point (bf16 wire, ``compressed_bf16_n8``) and a DOUBLE-BUFFERED point
+    (1-step-stale overlap, ``double_buffered_n8``).  Both are skipped
+    when the caller passes a trimmed ``ns`` (the over-budget path)."""
+    def run_point(n, grad_dtype=None, double_buffering=False):
         env = dict(os.environ)
         env["JAX_PLATFORMS"] = "cpu"
         env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
                             + f" --xla_force_host_platform_device_count={n}")
-        tag = f"n={n}" + (f" wire={grad_dtype}" if grad_dtype else "")
+        tag = (f"n={n}" + (f" wire={grad_dtype}" if grad_dtype else "")
+               + (" double-buffered" if double_buffering else ""))
         print(f"bench: scaling point {tag} ...", file=sys.stderr)
         cmd = [sys.executable, os.path.abspath(__file__),
                "--scaling-worker", str(n)]
         if grad_dtype:
             cmd += ["--allreduce-grad-dtype", grad_dtype]
+        if double_buffering:
+            cmd += ["--double-buffering"]
         out = None
         try:
             out = subprocess.run(cmd, capture_output=True, text=True,
@@ -547,22 +554,28 @@ def run_scaling_sweep(ns=(1, 2, 4, 8, 16, 32)):
                   file=sys.stderr)
             return None
 
+    def finalize_point(p, base):
+        if not p:
+            return p
+        if base:
+            p["eff_pct"] = round(100.0 * p["total_ips"] / base, 1)
+        p["total_ips"] = round(p["total_ips"], 2)
+        for k in ("step_ms", "grad_pmean_ms"):
+            if k in p:
+                p[k] = round(p[k], 1)
+        return p
+
     points = {}
     for n in ns:
         points[str(n)] = run_point(n)
     base = (points.get("1") or {}).get("total_ips")
     for p in points.values():
-        if p and base:
-            p["eff_pct"] = round(100.0 * p["total_ips"] / base, 1)
-        if p:
-            p["total_ips"] = round(p["total_ips"], 2)
-            for k in ("step_ms", "grad_pmean_ms"):
-                if k in p:
-                    p[k] = round(p[k], 1)
-    compressed = run_point(8, grad_dtype="bfloat16")
-    if compressed and base:
-        compressed["eff_pct"] = round(100.0 * compressed["total_ips"] / base, 1)
-        compressed["total_ips"] = round(compressed["total_ips"], 2)
+        finalize_point(p, base)
+    full_sweep = len(ns) > 4  # the over-budget path trims; skip extras too
+    compressed = (finalize_point(run_point(8, grad_dtype="bfloat16"), base)
+                  if full_sweep else None)
+    double_buf = (finalize_point(run_point(8, double_buffering=True), base)
+                  if full_sweep else None)
     eff8 = (points.get("8") or {}).get("eff_pct")
     try:
         cores = os.cpu_count()
@@ -570,6 +583,7 @@ def run_scaling_sweep(ns=(1, 2, 4, 8, 16, 32)):
         cores = None
     return {"per_chip_batch": 8, "arch": "resnet18", "points": points,
             "compressed_bf16_n8": compressed,
+            "double_buffered_n8": double_buf,
             "efficiency_pct": eff8,
             "host_physical_cores": cores,
             "total_ips": {k: (p or {}).get("total_ips") for k, p in
@@ -651,11 +665,13 @@ def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--scaling-worker", type=int, default=None)
     parser.add_argument("--allreduce-grad-dtype", default=None)
+    parser.add_argument("--double-buffering", action="store_true")
     parser.add_argument("--skip-scaling", action="store_true")
     args = parser.parse_args()
 
     if args.scaling_worker is not None:
-        scaling_worker(args.scaling_worker, args.allreduce_grad_dtype)
+        scaling_worker(args.scaling_worker, args.allreduce_grad_dtype,
+                       double_buffering=args.double_buffering)
         return
 
     # The one JSON line prints only at the END — if a driver-side timeout
