@@ -239,3 +239,27 @@ class TestFileDataset:
             for _ in range(20):  # slots already assembled may serve first
                 next(it)
         it.close()
+
+    def test_state_roundtrip_resumes_file_stream(self, tmp_path, use_native):
+        """checkpoint/resume (state_dict contract) over the DISK-backed
+        source: the restored iterator replays the identical batch stream."""
+        from chainermn_tpu.runtime import FileDataset
+
+        self._write(tmp_path)
+        ds = FileDataset(str(tmp_path))
+        it = PrefetchIterator(ds, batch_size=16, shuffle=True, seed=9,
+                              use_native=use_native, copy=True)
+        for _ in range(4):
+            next(it)
+        state = it.state_dict()
+        want = [np.asarray(next(it)[1]) for _ in range(6)]
+
+        it2 = PrefetchIterator(FileDataset(str(tmp_path)), batch_size=16,
+                               shuffle=True, seed=9, use_native=use_native,
+                               copy=True)
+        it2.load_state_dict(state)
+        got = [np.asarray(next(it2)[1]) for _ in range(6)]
+        for g, w in zip(got, want):
+            np.testing.assert_array_equal(g, w)
+        it.close()
+        it2.close()
