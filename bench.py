@@ -492,7 +492,7 @@ def scaling_worker(n, grad_dtype=None, double_buffering=False):
     # gradient-sized pmean in isolation (same dtype as the wire)
     if n > 1:
         from jax.sharding import PartitionSpec as P
-        from jax.experimental.shard_map import shard_map
+        from jax import shard_map
 
         import chainermn_tpu as mn
 

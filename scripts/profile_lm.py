@@ -104,11 +104,11 @@ def main():
                           lambda pp, b: loss_fn(pp, b)))
     # loss_fn references axis_name="model": must run under shard_map/jit with
     # mesh axes. Use a 1-device-model trick: wrap with jax.jit over the mesh.
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
     smapped = shard_map(
         jax.value_and_grad(lambda pp, b: loss_fn(pp, b)),
         mesh=mesh, in_specs=(specs, (P("data"),)),
-        out_specs=(P(), specs), check_rep=False)
+        out_specs=(P(), specs), check_vma=False)
     gfn = jax.jit(smapped)
     report["fwd_bwd_ms"] = timeit(gfn, p, batch,
                                   scalarize=lambda o: o[0])
@@ -116,7 +116,7 @@ def main():
     # --- fwd only ----------------------------------------------------------
     fwd = jax.jit(shard_map(loss_fn, mesh=mesh,
                             in_specs=(specs, (P("data"),)), out_specs=P(),
-                            check_rep=False))
+                            check_vma=False))
     report["fwd_ms"] = timeit(fwd, p, batch)
 
     # --- body only: transformer blocks without the vocab CE ----------------
@@ -136,11 +136,11 @@ def main():
 
     bfwd = jax.jit(shard_map(body_loss, mesh=mesh,
                              in_specs=(specs, (P("data"),)), out_specs=P(),
-                             check_rep=False))
+                             check_vma=False))
     report["body_fwd_ms"] = timeit(bfwd, p, batch)
     bgrad = jax.jit(shard_map(jax.value_and_grad(body_loss), mesh=mesh,
                               in_specs=(specs, (P("data"),)),
-                              out_specs=(P(), specs), check_rep=False))
+                              out_specs=(P(), specs), check_vma=False))
     report["body_fwd_bwd_ms"] = timeit(bgrad, p, batch,
                                        scalarize=lambda o: o[0])
 
