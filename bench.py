@@ -23,10 +23,16 @@ Honesty layer (round-2):
   * On a real TPU chip, a per-chip batch sweep shows where throughput
     saturates.
 
-Prints ONE JSON line on stdout:
+Prints the result JSON line on stdout INCREMENTALLY: the full line is
+emitted as soon as the headline section completes and re-emitted (enriched)
+after every later section, so the LAST parseable stdout line is always a
+complete result no matter when a driver-side timeout kills the process
+(round-3 lesson: BENCH_r03.json was rc=124/parsed-null because the line
+printed only at the end).  Schema:
   {"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": N,
    "mfu": N|null, "suspect": bool, "flops_per_image": N,
-   "batch_sweep": {...}, "scaling": {"total_ips": {...}, "efficiency_pct": N}}
+   "batch_sweep": {...}, "scaling": {"total_ips": {...}, "efficiency_pct": N},
+   "sections_complete": [...], "wall_clock_s": N}
 Everything else (warnings, progress) goes to stderr.
 """
 
@@ -520,15 +526,26 @@ def scaling_worker(n, grad_dtype=None, double_buffering=False):
     print(json.dumps(out))
 
 
-def run_scaling_sweep(ns=(1, 2, 4, 8, 16, 32)):
+def run_scaling_sweep(ns=(1, 2, 4, 8), over_budget=None, budget_left=None):
     """Weak-scaling sweep in fresh CPU subprocesses (platform is per-process).
 
     Reports per-point efficiency vs n=1 and the measured gradient-pmean
     time, plus two extra n=8 points so the reference's v1.2 headline
     features (SURVEY.md §6) each have a recorded number: a COMPRESSED
     point (bf16 wire, ``compressed_bf16_n8``) and a DOUBLE-BUFFERED point
-    (1-step-stale overlap, ``double_buffered_n8``).  Both are skipped
-    when the caller passes a trimmed ``ns`` (the over-budget path)."""
+    (1-step-stale overlap, ``double_buffered_n8``).
+
+    Default tops out at n=8: docs/SCALING.md shows the n=16/32 tail
+    measures single-core XLA host scheduling, not interconnect, and its
+    16-50s steps are what timed out the round-3 driver bench
+    (BENCH_r03.json rc=124).  ``--full-sweep`` restores it.  Every point
+    — including the two extras — is additionally gated on the
+    ``over_budget`` callable so a slow host degrades gracefully instead
+    of losing the whole artifact, and each subprocess's timeout is capped
+    by ``budget_left`` so a single slow point cannot overrun the budget
+    by its full 1800 s allowance."""
+    over_budget = over_budget or (lambda: False)
+    budget_left = budget_left or (lambda: 1800.0)
     def run_point(n, grad_dtype=None, double_buffering=False):
         env = dict(os.environ)
         env["JAX_PLATFORMS"] = "cpu"
@@ -546,7 +563,8 @@ def run_scaling_sweep(ns=(1, 2, 4, 8, 16, 32)):
         out = None
         try:
             out = subprocess.run(cmd, capture_output=True, text=True,
-                                 timeout=1800, env=env)
+                                 timeout=min(1800.0, max(60.0, budget_left())),
+                                 env=env)
             return json.loads(out.stdout.strip().splitlines()[-1])
         except Exception as e:
             print(f"bench: scaling point {tag} failed: {e!r}\n"
@@ -567,15 +585,19 @@ def run_scaling_sweep(ns=(1, 2, 4, 8, 16, 32)):
 
     points = {}
     for n in ns:
+        if over_budget():
+            print(f"bench: over budget — scaling sweep stops before n={n}",
+                  file=sys.stderr)
+            break
         points[str(n)] = run_point(n)
     base = (points.get("1") or {}).get("total_ips")
     for p in points.values():
         finalize_point(p, base)
-    full_sweep = len(ns) > 4  # the over-budget path trims; skip extras too
+    extras_ok = "8" in points and not over_budget()
     compressed = (finalize_point(run_point(8, grad_dtype="bfloat16"), base)
-                  if full_sweep else None)
+                  if extras_ok else None)
     double_buf = (finalize_point(run_point(8, double_buffering=True), base)
-                  if full_sweep else None)
+                  if extras_ok and not over_budget() else None)
     eff8 = (points.get("8") or {}).get("eff_pct")
     try:
         cores = os.cpu_count()
@@ -667,6 +689,9 @@ def main():
     parser.add_argument("--allreduce-grad-dtype", default=None)
     parser.add_argument("--double-buffering", action="store_true")
     parser.add_argument("--skip-scaling", action="store_true")
+    parser.add_argument("--full-sweep", action="store_true",
+                        help="include the n=16/32 virtual-mesh points "
+                             "(slow; measures host scheduling only)")
     args = parser.parse_args()
 
     if args.scaling_worker is not None:
@@ -674,13 +699,16 @@ def main():
                        double_buffering=args.double_buffering)
         return
 
-    # The one JSON line prints only at the END — if a driver-side timeout
-    # kills a long run mid-way, everything is lost.  Optional sections
-    # therefore respect a wall-clock budget (the headline + transformer
-    # always run): when exceeded, later sections are skipped with a note
-    # and the scaling sweep drops its slow tail.
+    # Timeout-proofing (round-4, after BENCH_r03.json died rc=124/null):
+    # the result JSON line is emitted INCREMENTALLY — once as soon as the
+    # headline section completes (first few minutes), then re-emitted in
+    # full after every later section.  A driver that keeps the last
+    # parseable stdout line therefore always captures a complete headline
+    # no matter when it kills the process.  Optional sections additionally
+    # respect a wall-clock budget, and the scaling sweep is gated
+    # per-point.
     t_start = time.time()
-    budget_s = float(os.environ.get("BENCH_BUDGET_S", 2400))
+    budget_s = float(os.environ.get("BENCH_BUDGET_S", 1200))
 
     def over_budget():
         return time.time() - t_start > budget_s
@@ -813,62 +841,9 @@ def main():
                   file=sys.stderr)
     suspect = flops_suspect or mfu_suspect
 
-    # --- transformer LM: the FLOPs-dense half of the perf story ------------
-    transformer = None
-    transformer_large = None
-    if on_tpu:
-        try:
-            transformer = bench_transformer_lm()
-            # The headline suspect flag covers EVERY reported number: a
-            # physically impossible transformer MFU must not hide behind a
-            # credible ResNet one.
-            suspect = suspect or bool(transformer.get("suspect"))
-        except Exception as e:
-            print(f"bench: transformer section failed: {e!r}", file=sys.stderr)
-        try:
-            # 875M params: the matmul-dominated ceiling (0.72 compiled /
-            # 0.77 useful MFU measured on v5e — docs/PERF.md)
-            transformer_large = bench_transformer_lm(
-                per_chip_batch=4, d_model=2048, n_layers=16)
-            suspect = suspect or bool(transformer_large.get("suspect"))
-        except Exception as e:
-            print(f"bench: large-transformer section failed: {e!r}",
-                  file=sys.stderr)
-
-    # --- decode: generation perf over the KV cache -------------------------
-    decode = None
-    if on_tpu and not over_budget():
-        try:
-            decode = bench_decode()
-        except Exception as e:
-            print(f"bench: decode section failed: {e!r}", file=sys.stderr)
-    elif on_tpu:
-        print("bench: over budget — decode section skipped", file=sys.stderr)
-
-    # --- input pipeline: disk-fed vs synthetic -----------------------------
-    data_path = None
-    if on_tpu and not over_budget():
-        try:
-            data_path = bench_data_path()
-        except Exception as e:
-            print(f"bench: data-path section failed: {e!r}", file=sys.stderr)
-    elif on_tpu:
-        print("bench: over budget — data-path section skipped",
-              file=sys.stderr)
-
-    # --- long context: flash kernels at 8k/16k + LM step at 4096 -----------
-    long_context = None
-    if on_tpu and not over_budget():
-        try:
-            long_context = bench_long_context()
-        except Exception as e:
-            print(f"bench: long-context section failed: {e!r}",
-                  file=sys.stderr)
-    elif on_tpu:
-        print("bench: over budget — long-context section skipped",
-              file=sys.stderr)
-
     # --- projected pod-scale DP efficiency (measured step + spec ICI) ------
+    # Cheap (pure arithmetic from already-measured quantities) so it goes
+    # into the FIRST emitted line rather than risking loss at the tail.
     projected = None
     if on_tpu:
         step_ms = dt / steps * 1e3
@@ -879,16 +854,7 @@ def main():
                                             dev.device_kind, 2),
         }
 
-    # --- DP weak-scaling sweep (virtual CPU mesh, fresh subprocesses) ------
-    scaling = None
-    if not args.skip_scaling:
-        ns = (1, 2, 4, 8) if over_budget() else (1, 2, 4, 8, 16, 32)
-        if len(ns) == 4:
-            print("bench: over budget — scaling sweep drops n=16/32",
-                  file=sys.stderr)
-        scaling = run_scaling_sweep(ns)
-
-    print(json.dumps({
+    result = {
         "metric": "resnet50_images_per_sec_per_chip",
         "value": round(headline_ips, 2),
         "unit": "images/sec/chip",
@@ -903,14 +869,101 @@ def main():
         "flops_source": flops_source if flops_per_image else None,
         "allreduce_grad_dtype": args.allreduce_grad_dtype,
         "batch_sweep": batch_sweep,
-        "transformer_lm": transformer,
-        "transformer_lm_large": transformer_large,
-        "decode": decode,
-        "data_path": data_path,
-        "long_context": long_context,
+        "transformer_lm": None,
+        "transformer_lm_large": None,
+        "decode": None,
+        "data_path": None,
+        "long_context": None,
         "projected_scaling": projected,
-        "scaling": scaling,
-    }))
+        "scaling": None,
+        "sections_complete": ["headline"],
+        "wall_clock_s": None,
+    }
+
+    def emit(section=None):
+        """Re-print the FULL result line; ``section`` is recorded in
+        ``sections_complete`` only when it actually SUCCEEDED (callers pass
+        it after the result field is assigned; failed sections re-emit with
+        no section so a null field is never advertised as complete)."""
+        if section and section not in result["sections_complete"]:
+            result["sections_complete"].append(section)
+        result["suspect"] = suspect
+        result["wall_clock_s"] = round(time.time() - t_start, 1)
+        print(json.dumps(result), flush=True)
+
+    emit("headline")
+
+    # --- transformer LM: the FLOPs-dense half of the perf story ------------
+    if on_tpu:
+        try:
+            result["transformer_lm"] = t = bench_transformer_lm()
+            # The headline suspect flag covers EVERY reported number: a
+            # physically impossible transformer MFU must not hide behind a
+            # credible ResNet one.
+            suspect = suspect or bool(t.get("suspect"))
+            emit("transformer_lm")
+        except Exception as e:
+            print(f"bench: transformer section failed: {e!r}", file=sys.stderr)
+            emit()
+        try:
+            # 875M params: the matmul-dominated ceiling (0.72 compiled /
+            # 0.77 useful MFU measured on v5e — docs/PERF.md)
+            result["transformer_lm_large"] = t = bench_transformer_lm(
+                per_chip_batch=4, d_model=2048, n_layers=16)
+            suspect = suspect or bool(t.get("suspect"))
+            emit("transformer_lm_large")
+        except Exception as e:
+            print(f"bench: large-transformer section failed: {e!r}",
+                  file=sys.stderr)
+            emit()
+
+    # --- decode: generation perf over the KV cache -------------------------
+    if on_tpu and not over_budget():
+        try:
+            result["decode"] = bench_decode()
+            emit("decode")
+        except Exception as e:
+            print(f"bench: decode section failed: {e!r}", file=sys.stderr)
+            emit()
+    elif on_tpu:
+        print("bench: over budget — decode section skipped", file=sys.stderr)
+
+    # --- input pipeline: disk-fed vs synthetic -----------------------------
+    if on_tpu and not over_budget():
+        try:
+            result["data_path"] = bench_data_path()
+            emit("data_path")
+        except Exception as e:
+            print(f"bench: data-path section failed: {e!r}", file=sys.stderr)
+            emit()
+    elif on_tpu:
+        print("bench: over budget — data-path section skipped",
+              file=sys.stderr)
+
+    # --- long context: flash kernels at 8k/16k + LM step at 4096 -----------
+    if on_tpu and not over_budget():
+        try:
+            result["long_context"] = bench_long_context()
+            emit("long_context")
+        except Exception as e:
+            print(f"bench: long-context section failed: {e!r}",
+                  file=sys.stderr)
+            emit()
+    elif on_tpu:
+        print("bench: over budget — long-context section skipped",
+              file=sys.stderr)
+
+    # --- DP weak-scaling sweep (virtual CPU mesh, fresh subprocesses) ------
+    if not args.skip_scaling and not over_budget():
+        ns = (1, 2, 4, 8, 16, 32) if args.full_sweep else (1, 2, 4, 8)
+        budget_left = lambda: budget_s - (time.time() - t_start)  # noqa: E731
+        result["scaling"] = run_scaling_sweep(
+            ns, over_budget=over_budget, budget_left=budget_left)
+        emit("scaling")
+    elif not args.skip_scaling:
+        print("bench: over budget — scaling sweep skipped", file=sys.stderr)
+
+    emit("final")
 
 
 if __name__ == "__main__":
