@@ -57,6 +57,14 @@ def main():
                         help="wire dtype for the cross-chip gradient mean "
                              "(reference: pure_nccl allreduce_grad_dtype; "
                              "int8 = quantized ring, beyond-reference)")
+    parser.add_argument("--norm", default="bn",
+                        choices=["bn", "stalebn", "affine"],
+                        help="ResNet norm layer. For the MEASURED BN-free "
+                             "fast path use --arch nf_resnet50 instead "
+                             "(+20%% step throughput on v5e, docs/PERF.md); "
+                             "'stalebn'/'affine' are perf-probe knobs — "
+                             "stalebn DIVERGES in training "
+                             "(docs/evidence_stalebn_divergence.json)")
     parser.add_argument("--communicator", default="xla")
     parser.add_argument("--fsdp", action="store_true",
                         help="ZeRO-3: params, grads and optimizer state all "
@@ -89,11 +97,18 @@ def main():
         print(f"{args.arch}  chips={n_chips}  global_batch={global_batch}  "
               f"image={args.image_size}")
 
+    arch_kw = {"norm": args.norm} if args.norm != "bn" else {}
+    if arch_kw and not args.arch.startswith("resnet"):
+        parser.error("--norm applies to the resnet archs only")
     model = ARCHS[args.arch](num_classes=args.num_classes,
-                             stem_strides=2 if args.image_size >= 64 else 1)
+                             stem_strides=2 if args.image_size >= 64 else 1,
+                             **arch_kw)
     rng = jax.random.PRNGKey(0)
-    variables = model.init(
-        rng, jnp.zeros((1, args.image_size, args.image_size, 3)), train=False)
+    variables = dict(model.init(
+        rng, jnp.zeros((1, args.image_size, args.image_size, 3)), train=False))
+    # step contract is {'params', 'batch_stats'}; norm='affine' models
+    # (and the ViTs) init without the stats collection
+    variables.setdefault("batch_stats", {})
 
     lr = args.lr
     if args.warmup_steps:

@@ -77,3 +77,154 @@ def test_graft_entry_single_chip():
 def test_graft_entry_dryrun_multichip():
     import __graft_entry__ as ge
     ge.dryrun_multichip(8)
+
+
+# --- norm variants: StaleBatchNorm / Affine (the HBM-traffic knob) ---------
+# docs/PERF.md: BN's extra activation passes are 8.4 GB of ResNet-50's
+# 44 GB/step on v5e; stalebn removes them (measured +19% step throughput)
+# at the documented cost of one-step-stale normalization statistics.
+
+def test_stale_batchnorm_uses_stale_stats_and_updates_running():
+    from chainermn_tpu.models.resnet import StaleBatchNorm
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.randn(4, 3, 3, 2) * 3.0 + 1.5, jnp.float32)
+    m = StaleBatchNorm(train=True, dtype=jnp.float32)
+    v = m.init(jax.random.PRNGKey(0), x)
+    out, mut = m.apply(v, x, mutable=["batch_stats"])
+    # First call normalizes with the INIT stats (mean 0, var 1), not the
+    # batch's own — that is the stale contract.
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(x) / np.sqrt(1.0 + 1e-5), rtol=1e-5)
+    # EMA stats moved toward the CURRENT batch stats by 1-momentum; the
+    # last_* pair holds the batch stats exactly (the 1-step pipeline).
+    xf = np.asarray(x, np.float64)
+    bmean = xf.mean((0, 1, 2))
+    bvar = (xf ** 2).mean((0, 1, 2)) - bmean ** 2
+    np.testing.assert_allclose(np.asarray(mut["batch_stats"]["mean"]),
+                               0.1 * bmean, rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(mut["batch_stats"]["var"]),
+                               0.9 * 1.0 + 0.1 * bvar, rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(mut["batch_stats"]["last_mean"]),
+                               bmean, rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(mut["batch_stats"]["last_var"]),
+                               bvar, rtol=1e-4)
+    # Second call normalizes with EXACTLY the previous step's batch stats.
+    out2, _ = m.apply({**v, **mut}, x, mutable=["batch_stats"])
+    np.testing.assert_allclose(
+        np.asarray(out2), (xf - bmean) / np.sqrt(bvar + 1e-5), rtol=1e-4)
+
+
+def test_stale_batchnorm_eval_matches_bn_eval():
+    import flax.linen as nn
+    from chainermn_tpu.models.resnet import StaleBatchNorm
+    rs = np.random.RandomState(1)
+    x = jnp.asarray(rs.randn(8, 4, 4, 3), jnp.float32)
+    stats = {"mean": jnp.asarray([0.3, -1.0, 2.0]),
+             "var": jnp.asarray([1.5, 0.2, 4.0]),
+             # eval ignores the 1-step pipeline pair, but the module
+             # declares it, so the collection must carry it
+             "last_mean": jnp.zeros(3), "last_var": jnp.ones(3)}
+    params = {"scale": jnp.asarray([1.0, 2.0, 0.5]),
+              "bias": jnp.asarray([0.0, -1.0, 3.0])}
+    ours = StaleBatchNorm(train=False, dtype=jnp.float32).apply(
+        {"params": params, "batch_stats": stats}, x)
+    ref = nn.BatchNorm(use_running_average=True, epsilon=1e-5,
+                       dtype=jnp.float32).apply(
+        {"params": params, "batch_stats": stats}, x)
+    np.testing.assert_allclose(np.asarray(ours), np.asarray(ref), rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_resnet_affine_train_step_roundtrip():
+    # norm='affine' models have NO batch_stats; the step's output tree must
+    # still feed back in as input (regression: pytree mismatch on call 2).
+    comm = mn.create_communicator("xla")
+    model = ARCHS["resnet18"](num_classes=4, stem_strides=1, norm="affine")
+    variables = dict(model.init(jax.random.PRNGKey(0),
+                                jnp.zeros((1, 16, 16, 3)), train=False))
+    variables.setdefault("batch_stats", {})
+    opt = optax.sgd(0.1)
+    step = mn.make_flax_train_step(
+        model, lambda logits, b: (cross_entropy_loss(logits, b[1]), {}),
+        opt, mesh=comm.mesh)
+    variables = mn.replicate(variables, comm.mesh)
+    opt_state = mn.replicate(opt.init(variables["params"]), comm.mesh)
+    rs = np.random.RandomState(0)
+    batch = mn.shard_batch(
+        (rs.randn(16, 16, 16, 3).astype(np.float32),
+         rs.randint(0, 4, 16).astype(np.int32)), comm.mesh)
+    for _ in range(2):
+        variables, opt_state, loss, _ = step(variables, opt_state, batch)
+    assert np.isfinite(float(loss))
+
+
+def test_resnet_stalebn_train_step_updates_stats():
+    comm = mn.create_communicator("xla")
+    model = ARCHS["resnet18"](num_classes=4, stem_strides=1, norm="stalebn")
+    variables = dict(model.init(jax.random.PRNGKey(0),
+                                jnp.zeros((1, 16, 16, 3)), train=False))
+    opt = optax.sgd(0.1)
+    step = mn.make_flax_train_step(
+        model, lambda logits, b: (cross_entropy_loss(logits, b[1]), {}),
+        opt, mesh=comm.mesh)
+    before = np.concatenate([np.asarray(l).ravel() for l in
+                             jax.tree_util.tree_leaves(
+                                 variables["batch_stats"])])
+    variables = mn.replicate(variables, comm.mesh)
+    opt_state = mn.replicate(opt.init(variables["params"]), comm.mesh)
+    rs = np.random.RandomState(0)
+    batch = mn.shard_batch(
+        (rs.randn(16, 16, 16, 3).astype(np.float32) * 2 + 1,
+         rs.randint(0, 4, 16).astype(np.int32)), comm.mesh)
+    variables, opt_state, loss, _ = step(variables, opt_state, batch)
+    after = np.concatenate([np.asarray(l).ravel() for l in
+                            jax.tree_util.tree_leaves(
+                                variables["batch_stats"])])
+    assert np.isfinite(float(loss))
+    assert not np.allclose(before, after)  # running stats moved
+
+
+def test_nf_resnet_signal_propagation_and_identity_init():
+    # SkipInit: every block starts as identity, so at init the network is
+    # stem -> pooling -> head; blocks must contribute nothing.
+    from chainermn_tpu.models.resnet import ARCHS
+    model = ARCHS["nf_resnet50"](num_classes=7, stem_strides=1)
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.randn(2, 32, 32, 3), jnp.float32)
+    v = model.init(jax.random.PRNGKey(0), x, train=False)
+    out = model.apply(v, x, train=True)
+    assert out.shape == (2, 7) and np.all(np.isfinite(np.asarray(out)))
+    # zero-init skip gains: perturbing a deep block's conv GAIN must not
+    # change the output at init (a uniform kernel shift would be cancelled
+    # by weight standardization itself and prove nothing)
+    p = jax.tree_util.tree_map(lambda a: a, v["params"])
+    key = [k for k in p if k.startswith("NFBottleneckBlock")][5]
+    p[key]["ScaledWSConv_0"]["gain"] = (
+        p[key]["ScaledWSConv_0"]["gain"] * 3.0 + 0.5)
+    out2 = model.apply({"params": p}, x, train=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(out2), atol=1e-6)
+
+
+def test_scaled_ws_conv_standardizes_weights():
+    # Whatever the raw kernel, the effective conv weight has zero mean and
+    # variance 1/fan_in per output channel (gain=1): feed a delta input to
+    # read the weights back out.
+    from chainermn_tpu.models.resnet import ScaledWSConv
+    conv = ScaledWSConv(4, (3, 3), dtype=jnp.float32)
+    v = conv.init(jax.random.PRNGKey(3), jnp.zeros((1, 8, 8, 2)))
+    # un-standardized raw kernel, deliberately skewed
+    v = {"params": {"kernel": v["params"]["kernel"] * 5 + 2.0,
+                    "gain": v["params"]["gain"]}}
+    x = jnp.zeros((1, 5, 5, 2)).at[0, 2, 2, 0].set(1.0)
+    y = conv.apply(v, x)  # y[0, 1:4, 1:4, f] = flipped kernel slice c=0
+    w_eff = np.asarray(y[0, 1:4, 1:4, :])
+    # per-output-channel mean over the c=0 slice isn't exactly 0 (mean is
+    # over BOTH input channels), so check the full standardization via two
+    # deltas instead
+    x2 = jnp.zeros((1, 5, 5, 2)).at[0, 2, 2, 1].set(1.0)
+    w_all = np.stack([w_eff, np.asarray(conv.apply(v, x2)[0, 1:4, 1:4, :])])
+    fan_in = 3 * 3 * 2
+    for f in range(4):
+        wf = w_all[:, :, :, f]
+        assert abs(wf.mean()) < 1e-6
+        np.testing.assert_allclose(wf.var() * fan_in, 1.0, rtol=2e-2)
