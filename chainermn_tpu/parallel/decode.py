@@ -34,10 +34,13 @@ from .transformer import _layer_norm, _project_qkv, apply_rope
 
 
 def _decoder_core(params, head_dim: int, axis_name: str):
-    """Shared incremental-decoding machinery: ``(embed, attn_block, rope)``.
+    """Shared incremental-decoding machinery:
+    ``(embed, attn_block, block_with, rope)``.
 
     ``attn_block`` derives its batch from ``x`` so the same core serves the
-    greedy path (batch B) and beam search (batch B·K).
+    greedy path (batch B) and beam search (batch B·K); ``block_with`` is
+    the underlying scaffolding with a pluggable attend stage (the lazy
+    beam swaps in its ancestry-masked attention there).
     """
     d_model = params["embed"].shape[1]
     rope = "pos_embed" not in params
@@ -54,45 +57,62 @@ def _decoder_core(params, head_dim: int, axis_name: str):
             x = x + jnp.take(params["pos_embed"], positions, axis=0)[None]
         return x
 
-    def attn_block(x, blk, k_cache, v_cache, positions, write_at, q_valid):
-        """x (N,S,D) → block output; caches written at ``write_at + i`` for
-        the i-th input position; query i attends cache [:q_valid + i + 1).
-        """
-        n = x.shape[0]
+    def block_with(x, blk, positions, attend):
+        """Shared block scaffolding: ln1 → qkv projection (+rope) →
+        pluggable ``attend(q, k, v) -> (ctx, extras)`` → wo row-parallel →
+        residual → ln2 → tp_mlp.  ONE copy of the model structure serves
+        the physical-cache path and the lazy-beam path; only the
+        score/context stage differs."""
+        n, s_q = x.shape[0], x.shape[1]
         h = _layer_norm(x, blk["ln1_scale"], blk["ln1_bias"])
         q, k, v = _project_qkv(h, blk["attn"], head_dim, axis_name)
         if rope:
             q = apply_rope(q, positions)
             k = apply_rope(k, positions)
-        k_cache = jax.lax.dynamic_update_slice_in_dim(k_cache, k, write_at, 1)
-        v_cache = jax.lax.dynamic_update_slice_in_dim(v_cache, v, write_at, 1)
-        # Per-query valid lengths make one formula serve prefill (causal)
-        # and decode (full prefix): query i sees q_valid + i + 1 entries.
-        s_q = q.shape[1]
-        valid = (q_valid + jnp.arange(s_q) + 1)[None, None, None, :, None]
-        hl, hkv = q.shape[2], k_cache.shape[2]
-        # Grouped attention against the UN-expanded cache (GQA's inference
-        # payoff): q heads regrouped onto their KV head — no per-tick
-        # n_heads-sized cache copy.
-        g = hl // hkv
-        q5 = q.reshape(n, s_q, hkv, g, head_dim)
-        s = jnp.einsum("bqhgd,bkhd->bhgqk", q5, k_cache,
-                       preferred_element_type=jnp.float32) / (head_dim ** 0.5)
-        mask = jnp.arange(k_cache.shape[1])[None, None, None, None, :] < valid
-        s = jnp.where(mask, s, -1e30)
-        p = jax.nn.softmax(s, axis=-1)
-        ctx = jnp.einsum("bhgqk,bkhd->bqhgd", p.astype(v_cache.dtype),
-                         v_cache,
-                         preferred_element_type=jnp.float32).astype(x.dtype)
+        ctx, extras = attend(q, k, v)
         ctx = ctx.reshape(n, s_q, -1)
         attn_out = row_parallel_dense(ctx, blk["attn"]["wo"],
                                       blk["attn"]["bo"], axis_name=axis_name)
         x = x + attn_out
         h = _layer_norm(x, blk["ln2_scale"], blk["ln2_bias"])
         from .tensor_parallel import tp_mlp
-        return x + tp_mlp(h, blk["mlp"], axis_name=axis_name), k_cache, v_cache
+        return (x + tp_mlp(h, blk["mlp"], axis_name=axis_name),) + extras
 
-    return embed, attn_block, rope
+    def attn_block(x, blk, k_cache, v_cache, positions, write_at, q_valid):
+        """x (N,S,D) → block output; caches written at ``write_at + i`` for
+        the i-th input position; query i attends cache [:q_valid + i + 1).
+        """
+        n = x.shape[0]
+
+        def attend(q, k, v):
+            kc = jax.lax.dynamic_update_slice_in_dim(k_cache, k, write_at, 1)
+            vc = jax.lax.dynamic_update_slice_in_dim(v_cache, v, write_at, 1)
+            # Per-query valid lengths make one formula serve prefill
+            # (causal) and decode (full prefix): query i sees
+            # q_valid + i + 1 entries.
+            s_q = q.shape[1]
+            valid = (q_valid + jnp.arange(s_q) + 1)[None, None, None, :, None]
+            hl, hkv = q.shape[2], kc.shape[2]
+            # Grouped attention against the UN-expanded cache (GQA's
+            # inference payoff): q heads regrouped onto their KV head — no
+            # per-tick n_heads-sized cache copy.
+            g = hl // hkv
+            q5 = q.reshape(n, s_q, hkv, g, head_dim)
+            s = jnp.einsum("bqhgd,bkhd->bhgqk", q5, kc,
+                           preferred_element_type=jnp.float32) \
+                / (head_dim ** 0.5)
+            mask = (jnp.arange(kc.shape[1])[None, None, None, None, :]
+                    < valid)
+            s = jnp.where(mask, s, -1e30)
+            p = jax.nn.softmax(s, axis=-1)
+            ctx = jnp.einsum("bhgqk,bkhd->bqhgd", p.astype(vc.dtype), vc,
+                             preferred_element_type=jnp.float32
+                             ).astype(x.dtype)
+            return ctx, (kc, vc)
+
+        return block_with(x, blk, positions, attend)
+
+    return embed, attn_block, block_with, rope
 
 
 def _check_length(params, total: int, rope: bool) -> None:
@@ -170,7 +190,7 @@ def lm_generate(params, prompt, rng: Optional[jax.Array] = None, *,
     """
     b, s_p = prompt.shape
     total = s_p + max_new_tokens
-    embed, attn_block, rope = _decoder_core(params, head_dim, axis_name)
+    embed, attn_block, _, rope = _decoder_core(params, head_dim, axis_name)
     _check_length(params, total, rope)
     blocks = params["blocks"]
 
@@ -230,7 +250,8 @@ def lm_generate(params, prompt, rng: Optional[jax.Array] = None, *,
 
 
 def lm_generate_beam(params, prompt, *, head_dim: int, axis_name: str,
-                     max_new_tokens: int, beam_size: int):
+                     max_new_tokens: int, beam_size: int,
+                     lazy_reorder: bool = True):
     """Beam search with the KV cache: the highest-cumulative-log-prob
     continuation of each prompt among ``beam_size`` beams.
 
@@ -241,11 +262,34 @@ def lm_generate_beam(params, prompt, *, head_dim: int, axis_name: str,
     TP-composed: per-shard top-K of the vocab-sharded log-probs, one small
     all_gather of ``K`` candidates per shard, replicated merge.  Returns
     ``(B, max_new_tokens) int32`` — the best beam.
+
+    ``lazy_reorder=True`` (default) kills the per-tick cache-reorder
+    bandwidth tax that made beam-4 cost 9× greedy per token (round-3
+    BENCH): instead of physically gathering the (B·K, total, h, d) caches
+    by parent each step (read+write of the whole cache, on top of the
+    read attention itself needs), the caches are never moved —
+
+    * prompt K/V is computed once at batch B and SHARED by all beams
+      (read once per tick, not K times, and not stored K times);
+    * each beam SLOT owns an append-only generated-token cache; a tiny
+      ``(B, K, max_new)`` int32 ancestry table says which slot held this
+      beam's token at each past position, and only the table is
+      reordered by parent (kilobytes, not the gigabyte cache);
+    * attention scores are computed against ALL K slots and the ancestry
+      mask selects the one true writer per position — K× more score
+      FLOPs on a (head_dim)-deep dot, nothing on the bandwidth that
+      actually bounds decode.  Softmax runs over the joint
+      prompt+generated axis, so the result is numerically the standard
+      beam attention.
+
+    ``lazy_reorder=False`` keeps the physical-gather path (the parity
+    oracle for tests).
     """
     b, s_p = prompt.shape
     k = beam_size
     total = s_p + max_new_tokens
-    embed, attn_block, rope = _decoder_core(params, head_dim, axis_name)
+    embed, attn_block, block_with, rope = _decoder_core(
+        params, head_dim, axis_name)
     _check_length(params, total, rope)
     blocks = params["blocks"]
 
@@ -276,6 +320,12 @@ def lm_generate_beam(params, prompt, *, head_dim: int, axis_name: str,
         ids = jnp.take_along_axis(gi, pos, axis=1)
         return v, ids
 
+    if lazy_reorder:
+        return _beam_lazy(params, prompt, embed, attn_block, block_with,
+                          global_topk, head_dim=head_dim,
+                          axis_name=axis_name,
+                          max_new_tokens=max_new_tokens, beam_size=k)
+
     # ---- prefill once at batch B, then tile caches to B·K ----
     h, caches = _prefill(params, embed, attn_block, prompt, total, head_dim)
     caches = [(jnp.repeat(kc, k, axis=0), jnp.repeat(vc, k, axis=0))
@@ -295,17 +345,10 @@ def lm_generate_beam(params, prompt, *, head_dim: int, axis_name: str,
             x, kc, vc = attn_block(x, blk, kc, vc, pos[None], pos, pos)
             new_caches.append((kc, vc))
         h = _layer_norm(x, params["lnf_scale"], params["lnf_bias"])
-        v_k, i_k = global_topk(h[:, -1])                         # (B·K, K)
-        cand = scores[:, :, None] + v_k.reshape(b, k, k)         # (B, K, K)
-        flat = cand.reshape(b, k * k)
-        scores, pos_flat = jax.lax.top_k(flat, k)                # (B, K)
-        parent = pos_flat // k                                   # (B, K)
-        tokens = jnp.take_along_axis(
-            i_k.reshape(b, k, k).reshape(b, k * k), pos_flat, axis=1
-        ).astype(jnp.int32)
-        # Reindex histories and caches by the winning parents.
-        toks_buf = jnp.take_along_axis(toks_buf, parent[:, :, None], axis=1)
-        toks_buf = toks_buf.at[:, :, i].set(tokens)
+        tokens, scores, toks_buf, parent = _merge_candidates(
+            global_topk, h, scores, toks_buf, i, b, k)
+        # Reindex the full caches by the winning parents (the bandwidth
+        # tax the lazy path avoids).
         reind = []
         for kc, vc in new_caches:
             shp = kc.shape  # (B·K, total, hkv, hd)
@@ -326,15 +369,151 @@ def lm_generate_beam(params, prompt, *, head_dim: int, axis_name: str,
     return toks_buf[:, 0].astype(jnp.int32)
 
 
+def _merge_candidates(global_topk, h, scores, toks_buf, i, b, k):
+    """Shared beam bookkeeping for BOTH cache strategies: global top-K of
+    the K·K candidate continuations, then reorder the token history by the
+    winning parents.  Returns ``(tokens, scores, toks_buf, parent)`` —
+    the caller decides what ELSE the parents reindex (physical caches vs
+    the ancestry table)."""
+    v_k, i_k = global_topk(h[:, -1])                             # (B·K, K)
+    cand = scores[:, :, None] + v_k.reshape(b, k, k)             # (B, K, K)
+    flat = cand.reshape(b, k * k)
+    scores, pos_flat = jax.lax.top_k(flat, k)                    # (B, K)
+    parent = pos_flat // k                                       # (B, K)
+    tokens = jnp.take_along_axis(
+        i_k.reshape(b, k, k).reshape(b, k * k), pos_flat, axis=1
+    ).astype(jnp.int32)
+    toks_buf = jnp.take_along_axis(toks_buf, parent[:, :, None], axis=1)
+    toks_buf = toks_buf.at[:, :, i].set(tokens)
+    return tokens, scores, toks_buf, parent
+
+
+def _beam_lazy(params, prompt, embed, attn_block, block_with, global_topk, *,
+               head_dim: int, axis_name: str, max_new_tokens: int,
+               beam_size: int):
+    """Ancestry-indexed beam decode body (see ``lm_generate_beam``
+    docstring): shared prompt cache + per-slot append-only generated
+    caches + a reordered index table instead of reordered caches."""
+    b, s_p = prompt.shape
+    k = beam_size
+    blocks = params["blocks"]
+    n_kv = _kv_heads(params, head_dim)
+
+    # prefill at batch B; caches sized to the PROMPT only (they are never
+    # extended — generated tokens live in the per-slot caches)
+    h, pcaches = _prefill(params, embed, attn_block, prompt, s_p, head_dim)
+    v0k, i0k = global_topk(h[:, -1])                             # (B, K)
+    scores = v0k
+    tokens = i0k.astype(jnp.int32)
+    toks_buf = jnp.zeros((b, k, max_new_tokens), jnp.int32)
+    toks_buf = toks_buf.at[:, :, 0].set(tokens)
+    def varying_zeros(shape, dtype):
+        # the scan writes device-VARYING K/V (they come from sharded
+        # params) into these buffers, so the initial carry must already
+        # carry the varying-manual-axes type
+        z = jnp.zeros(shape, dtype)
+        pcast = getattr(jax.lax, "pcast", None)
+        if pcast is not None:
+            return pcast(z, axis_name, to="varying")
+        return jax.lax.pvary(z, axis_name)
+
+    gen = [(varying_zeros((b, k, max_new_tokens, n_kv, head_dim), pk.dtype),
+            varying_zeros((b, k, max_new_tokens, n_kv, head_dim), pk.dtype))
+           for pk, _ in pcaches]
+    anc = jnp.zeros((b, k, max_new_tokens), jnp.int32)
+    gen_pos = jnp.arange(max_new_tokens)
+    slot_ids = jnp.arange(k)
+
+    def lazy_attn(x, blk, pk, pv, gk, gv, amask, pos, i):
+        """One block for the (B·K, 1, D) tick input, via the SHARED
+        ``block_with`` scaffolding — only the attend stage differs from
+        the physical path.
+
+        ``amask (B, K, K_slots, max_new) bool``: ancestry ∧ validity —
+        True where slot ``l``'s generated cache at position ``t`` belongs
+        to beam ``s``'s history.  Exactly one slot is True per valid t."""
+
+        def attend(q, kk, vv):
+            # append this tick's K/V into each slot's OWN row at pos i-1
+            gk2 = jax.lax.dynamic_update_slice_in_dim(
+                gk, kk.reshape(b, k, 1, n_kv, head_dim), i - 1, axis=2)
+            gv2 = jax.lax.dynamic_update_slice_in_dim(
+                gv, vv.reshape(b, k, 1, n_kv, head_dim), i - 1, axis=2)
+            hl = q.shape[2]
+            g = hl // n_kv
+            q6 = q.reshape(b, k, n_kv, g, head_dim)
+            scale = head_dim ** 0.5
+            # prompt scores: shared cache, read ONCE for all K beams
+            sp = jnp.einsum("bshgd,bthd->bshgt", q6, pk,
+                            preferred_element_type=jnp.float32) / scale
+            # generated scores against ALL slots; the ancestry mask
+            # selects the one true writer per position
+            sg = jnp.einsum("bshgd,blthd->bshglt", q6, gk2,
+                            preferred_element_type=jnp.float32) / scale
+            sg = jnp.where(amask[:, :, None, None, :, :], sg, -1e30)
+            joint = jnp.concatenate(
+                [sp, sg.reshape(b, k, n_kv, g, k * gk2.shape[2])], axis=-1)
+            p = jax.nn.softmax(joint, axis=-1)
+            p_p = p[..., :s_p].astype(pv.dtype)
+            p_g = p[..., s_p:].reshape(sg.shape).astype(gv2.dtype)
+            ctx = (jnp.einsum("bshgt,bthd->bshgd", p_p, pv,
+                              preferred_element_type=jnp.float32)
+                   + jnp.einsum("bshglt,blthd->bshgd", p_g, gv2,
+                                preferred_element_type=jnp.float32))
+            return ctx.astype(x.dtype).reshape(b * k, 1, hl, head_dim), \
+                (gk2, gv2)
+
+        return block_with(x, blk, pos[None], attend)
+
+    def tick(carry, i):
+        tokens, scores, toks_buf, anc, gen = carry
+        pos = s_p + i - 1
+        # position i-1 was written by each slot itself
+        anc = jax.lax.dynamic_update_slice_in_dim(
+            anc, jnp.broadcast_to(slot_ids[None, :, None], (b, k, 1)),
+            i - 1, axis=2)
+        # ancestry ∧ validity (only positions < i exist)
+        amask = ((anc[:, :, None, :] == slot_ids[None, None, :, None])
+                 & (gen_pos[None, None, None, :] < i))
+        x = embed(tokens.reshape(b * k)[:, None], pos[None])
+        new_gen = []
+        for blk, (pk, pv), (gk, gv) in zip(blocks, pcaches, gen):
+            x, gk, gv = lazy_attn(x, blk, pk, pv, gk, gv, amask, pos, i)
+            new_gen.append((gk, gv))
+        h = _layer_norm(x, params["lnf_scale"], params["lnf_bias"])
+        v_k, i_k = global_topk(h[:, -1])                         # (B·K, K)
+        cand = scores[:, :, None] + v_k.reshape(b, k, k)
+        flat = cand.reshape(b, k * k)
+        scores, pos_flat = jax.lax.top_k(flat, k)
+        parent = pos_flat // k
+        tokens = jnp.take_along_axis(
+            i_k.reshape(b, k, k).reshape(b, k * k), pos_flat, axis=1
+        ).astype(jnp.int32)
+        # reorder the HISTORY VIEWS, not the caches: token buffer and the
+        # ancestry table (both kilobyte-sized)
+        toks_buf = jnp.take_along_axis(toks_buf, parent[:, :, None], axis=1)
+        toks_buf = toks_buf.at[:, :, i].set(tokens)
+        anc = jnp.take_along_axis(anc, parent[:, :, None], axis=1)
+        return (tokens, scores, toks_buf, anc, new_gen), None
+
+    if max_new_tokens > 1:
+        (tokens, scores, toks_buf, anc, gen), _ = jax.lax.scan(
+            tick, (tokens, scores, toks_buf, anc, gen),
+            jnp.arange(1, max_new_tokens))
+    return toks_buf[:, 0].astype(jnp.int32)
+
+
 def make_lm_beam_generator(mesh: Optional[Mesh] = None,
                            axis_name: str = "model", *, head_dim: int,
-                           max_new_tokens: int, beam_size: int):
+                           max_new_tokens: int, beam_size: int,
+                           lazy_reorder: bool = True):
     """Eager/jit face of :func:`lm_generate_beam`: ``fn(params, prompt) ->
     (B, max_new) tokens`` over TP-sharded global params."""
     return _make_face(
         mesh, axis_name,
         partial(lm_generate_beam, head_dim=head_dim, axis_name=axis_name,
-                max_new_tokens=max_new_tokens, beam_size=beam_size),
+                max_new_tokens=max_new_tokens, beam_size=beam_size,
+                lazy_reorder=lazy_reorder),
         has_rng=False)
 
 

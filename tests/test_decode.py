@@ -215,3 +215,23 @@ class TestBeamSearch:
             outs[tp] = np.asarray(gen(params, prompt))
         np.testing.assert_array_equal(outs[1], outs[2])
         np.testing.assert_array_equal(outs[1], outs[4])
+
+    @pytest.mark.parametrize("pos_impl,n_kv_heads",
+                             [("learned", None), ("rope", 2)])
+    def test_lazy_reorder_matches_physical(self, devices, pos_impl,
+                                           n_kv_heads):
+        # The ancestry-indexed beam (default) must pick the SAME tokens as
+        # the physical cache-gather oracle — the lazy path only changes
+        # where bytes move, not the math.
+        from chainermn_tpu.parallel import make_lm_beam_generator
+
+        params = self._make(pos_impl=pos_impl, n_kv_heads=n_kv_heads,
+                            seed=8)
+        prompt = np.random.RandomState(8).randint(
+            0, VOCAB, (B, S_P)).astype(np.int32)
+        mesh = mn.make_nd_mesh(("data", "model"), (1, 2), devices[:2])
+        kw = dict(head_dim=HEAD_DIM, max_new_tokens=NEW, beam_size=3)
+        lazy = make_lm_beam_generator(mesh, "model", lazy_reorder=True, **kw)
+        phys = make_lm_beam_generator(mesh, "model", lazy_reorder=False, **kw)
+        np.testing.assert_array_equal(np.asarray(lazy(params, prompt)),
+                                      np.asarray(phys(params, prompt)))
