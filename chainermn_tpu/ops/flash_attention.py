@@ -13,12 +13,19 @@ Forward: one Pallas kernel, grid ``(B·H, S/block_q, S/block_k)``, the last
 dimension sequential ("arbitrary") so scratch accumulates across K blocks.
 Saves the log-sum-exp alongside the output.
 
-Backward: two Pallas kernels — dQ (K-sequential grid, fp32 VMEM
-accumulator) and fused dK/dV ((group, Q)-sequential grid, two fp32 VMEM
-accumulators; the GQA head-group fold happens in-scratch) — both
-recomputing probabilities from the saved LSE (``p = exp(s − lse)`` is the
-exact softmax, no renormalisation pass) with causal block skipping.
-O(S·block) live memory, no O(S²) tensor, either direction.  A
+Backward: ONE fused Pallas kernel (round 4; previously a dQ + dKV pair
+that recomputed ``qk``/``do·v`` twice and read the operands from HBM
+twice).  Grid is K-major with (group, Q) sequential: dk/dv accumulate in
+fp32 VMEM scratch (the GQA head-group fold happens in-scratch), while
+each cell's dq contribution is written as a per-K-block PARTIAL slab —
+input dtype, summed in fp32 by one XLA reduce — because K-major cells
+visit a given q block non-consecutively (no scratch residency) and HBM
+read-modify-write aliasing would race the block prefetch at diagonal
+corners.  Probabilities recompute from the saved LSE (``p = exp(s −
+lse)`` is the exact softmax, no renormalisation pass); causal
+above-diagonal cells are skipped AND their dead block DMA elided by
+index-map clamping.  O(S·block) live memory in VMEM, an O(nk·S·D)
+HBM transient for the dq partials.  A
 ``lax.scan`` XLA fallback (``backward='xla'``) covers Mosaic-hostile
 block geometries and serves as the oracle in tests.  On CPU (tests,
 debugging) the kernels run in Pallas interpret mode; the math is
@@ -187,13 +194,22 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret, seq_len,
         _fwd_kernel, scale=scale, causal=causal,
         block_q=bq, block_k=bk, num_kblocks=nk,
         seq_len=None if seq_len == s else seq_len)
+
+    def kv_index(b, i, j):
+        # Causal: K blocks past the diagonal are pl.when-skipped — clamp
+        # their index to the diagonal block so Pallas's revisit detection
+        # elides the (otherwise dead) K/V DMA for the whole skipped tail.
+        if causal:
+            j = jnp.minimum(j, (i * bq + bq - 1) // bk)
+        return (b // group, j, 0)
+
     out, lse = pl.pallas_call(
         kernel,
         grid=(bh, nq, nk),
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, i, j: (b // group, j, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, i, j: (b // group, j, 0)),
+            pl.BlockSpec((1, bk, d), kv_index),
+            pl.BlockSpec((1, bk, d), kv_index),
         ],
         out_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
@@ -276,72 +292,28 @@ def _bwd_blockwise(q, k, v, out, lse, do, causal, scale, block_k, seq_len,
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-               dq_acc, *, scale, causal, block_q, block_k, num_kblocks,
-               seq_len):
-    """dQ: grid ``(B·H, S/block_q, S/block_k)``, K sequential — dq for one
-    Q block accumulates across K blocks in VMEM scratch, exactly mirroring
-    the forward's revolving-accumulator pattern."""
-    iq, jk = pl.program_id(1), pl.program_id(2)
+def _bwd_fused_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
+                      dqp_ref, dk_ref, dv_ref, dk_acc, dv_acc, *, scale,
+                      causal, block_q, block_k, num_qblocks, group, seq_len):
+    """Fused backward: ONE kernel produces dk, dv AND dq.
 
-    @pl.when(jk == 0)
-    def _init():
-        dq_acc[...] = jnp.zeros_like(dq_acc)
+    Grid ``(B·H_kv, S/block_k, group, S/block_q)`` with the (group, Q)
+    dims sequential — one K block's dk/dv accumulate over every q head
+    sharing it (the GQA fold happens IN the scratch, in fp32) and every Q
+    block, exactly as the old dK/dV kernel did.  The difference: the
+    ``ds·k`` product this cell already has in registers ALSO yields this
+    (q-block, k-block) cell's dq contribution, so the old separate dQ
+    kernel — which re-did the qk and do·v matmuls and re-read q/k/v/do
+    from HBM — is gone (2 of 7 backward matmuls and half the backward
+    input DMA, measured +21% backward at S=8192, docs/PERF.md round 4).
 
-    tail = seq_len is not None
-    run = (jk * block_k <= iq * block_q + block_q - 1) if causal else True
-    if tail:
-        run = jnp.logical_and(run, jk * block_k < seq_len)
-
-    @pl.when(run)
-    def _body():
-        q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
-        # lse/delta ride as (1, 1, S) full rows (Mosaic wants (8, 128)-
-        # aligned or full-size trailing block dims); slice the q block here.
-        lse = lse_ref[0, 0, pl.dslice(iq * block_q, block_q)]
-        delta = delta_ref[0, 0, pl.dslice(iq * block_q, block_q)]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale  # (bq, bk)
-        p = jnp.exp(s - lse[:, None])                    # exact softmax
-        if causal or tail:
-            q_pos = iq * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            k_pos = jk * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            mask = (q_pos >= k_pos) if causal else (k_pos == k_pos)
-            if tail:
-                # Padded q rows carry lse ≈ -inf (exp overflows); padded k
-                # columns must contribute nothing.  Mask both.
-                mask = jnp.logical_and(
-                    mask, jnp.logical_and(k_pos < seq_len, q_pos < seq_len))
-            p = jnp.where(mask, p, 0.0)
-        dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)          # (bq, bk)
-        ds = p * (dp - delta[:, None]) * scale
-        dq_acc[...] += jax.lax.dot_general(
-            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-
-    if causal:
-        last = jnp.minimum(
-            (iq * block_q + block_q - 1) // block_k, num_kblocks - 1)
-    else:
-        last = num_kblocks - 1
-
-    @pl.when(jk == last)
-    def _fin():
-        dq_ref[0] = dq_acc[...].astype(dq_ref.dtype)
-
-
-def _dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
-                dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal,
-                block_q, block_k, num_qblocks, group, seq_len):
-    """dK/dV: grid ``(B·H_kv, S/block_k, group, S/block_q)`` with the
-    (group, Q) dims sequential — one K block's dk/dv accumulate over every
-    q head sharing it (GQA fold happens IN the scratch, in fp32) and every
-    Q block.  Causal Q blocks entirely above the diagonal are skipped."""
+    dq contributions cannot accumulate in scratch here (the grid is
+    K-major; a q block's contributions arrive across non-consecutive
+    cells) and HBM read-modify-write via input/output aliasing would race
+    Pallas's block prefetch at the diagonal corners, so each K block
+    writes its dq PARTIAL to its own ``(B·H, nk, S, D)`` slab slice and
+    one XLA sum over nk finishes the job — O(nk·S·D) fp32 transient,
+    ~0.7 ms of the ~5 ms the fusion saves at S=8192."""
     jk, g, iq = pl.program_id(1), pl.program_id(2), pl.program_id(3)
 
     @pl.when(jnp.logical_and(g == 0, iq == 0))
@@ -383,6 +355,15 @@ def _dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
         dk_acc[...] += jax.lax.dot_general(
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
+        dqp_ref[0, 0] = jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32).astype(dqp_ref.dtype)  # bf16 partial: fp32 sum outside
+
+    @pl.when(jnp.logical_not(run))
+    def _skip():
+        # this cell's partial slice is summed unconditionally outside —
+        # unwritten blocks would be uninitialized memory, not zeros
+        dqp_ref[0, 0] = jnp.zeros_like(dqp_ref[0, 0])
 
     @pl.when(jnp.logical_and(g == group - 1, iq == num_qblocks - 1))
     def _fin():
@@ -392,7 +373,8 @@ def _dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
 
 def _bwd_pallas(q, k, v, out, lse, do, causal, scale, block_q, block_k,
                 interpret, seq_len, group, dlse=None):
-    """Pallas dq/dk/dv: two kernels sharing one XLA-precomputed
+    """Pallas dq/dk/dv via the ONE fused kernel (see
+    :func:`_bwd_fused_kernel`), sharing one XLA-precomputed
     ``delta = rowsum(do·out) − dlse`` (the LSE cotangent folds in exactly:
     ``ds = p·(dp − delta + dlse)``).  Same blockwise-LSE math as
     :func:`_bwd_blockwise`, but the (S, block) score recompute never leaves
@@ -413,45 +395,44 @@ def _bwd_pallas(q, k, v, out, lse, do, causal, scale, block_q, block_k,
     delta = delta[:, None, :]
     sl = None if seq_len == s else seq_len
 
-    dq = pl.pallas_call(
-        functools.partial(
-            _dq_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk,
-            num_kblocks=nk, seq_len=sl),
-        grid=(bh, nq, nk),
-        in_specs=[
-            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, i, j: (b // group, j, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, i, j: (b // group, j, 0)),
-            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, 1, s), lambda b, i, j: (b, 0, 0)),
-            pl.BlockSpec((1, 1, s), lambda b, i, j: (b, 0, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype, vma=vma),
-        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary")),
-        interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    def qdo_index(b, j, g, i):
+        # Q blocks strictly above the diagonal (i·bq + bq − 1 < j·bk) are
+        # pl.when-skipped — clamp them up to the first contributing block
+        # so Pallas's revisit detection elides their dead Q/dO DMA
+        if causal:
+            i = jnp.maximum(i, (j * bk) // bq)
+        return (b * group + g, i, 0)
 
-    dk, dv = pl.pallas_call(
+    dq_part, dk, dv = pl.pallas_call(
         functools.partial(
-            _dkv_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk,
-            num_qblocks=nq, group=group, seq_len=sl),
+            _bwd_fused_kernel, scale=scale, causal=causal, block_q=bq,
+            block_k=bk, num_qblocks=nq, group=group, seq_len=sl),
         grid=(bh_kv, nk, group, nq),
         in_specs=[
             pl.BlockSpec((1, bk, d), lambda b, j, g, i: (b, j, 0)),
             pl.BlockSpec((1, bk, d), lambda b, j, g, i: (b, j, 0)),
-            pl.BlockSpec((1, bq, d), lambda b, j, g, i: (b * group + g, i, 0)),
-            pl.BlockSpec((1, bq, d), lambda b, j, g, i: (b * group + g, i, 0)),
+            pl.BlockSpec((1, bq, d), qdo_index),
+            pl.BlockSpec((1, bq, d), qdo_index),
             pl.BlockSpec((1, 1, s), lambda b, j, g, i: (b * group + g, 0, 0)),
             pl.BlockSpec((1, 1, s), lambda b, j, g, i: (b * group + g, 0, 0)),
         ],
         out_specs=[
+            # dq partials: UNclamped index — dead cells write their own
+            # zero slice (the sum below reads every slab slice)
+            pl.BlockSpec((1, 1, bq, d),
+                         lambda b, j, g, i: (b * group + g, j, i, 0)),
             pl.BlockSpec((1, bk, d), lambda b, j, g, i: (b, j, 0)),
             pl.BlockSpec((1, bk, d), lambda b, j, g, i: (b, j, 0)),
         ],
         out_shape=[
+            # partials in the INPUT dtype: bf16 models halve the slab
+            # traffic at the cost of rounding each of the nk per-K-block
+            # partials to bf16 BEFORE the fp32 sum (the sum itself adds no
+            # further error) — dq error vs the fp32-slab path measured
+            # ~0.5% relative, inside bf16 training noise, and pinned by
+            # the bf16 gradient parity test; fp32 callers (ring
+            # attention's fp32-grade parity) keep a full-precision slab
+            jax.ShapeDtypeStruct((bh, nk, s, d), q.dtype, vma=vma),
             jax.ShapeDtypeStruct((bh_kv, s, d), k.dtype, vma=vma),
             jax.ShapeDtypeStruct((bh_kv, s, d), v.dtype, vma=vma),
         ],
@@ -462,6 +443,7 @@ def _bwd_pallas(q, k, v, out, lse, do, causal, scale, block_q, block_k,
                                  "arbitrary")),
         interpret=interpret,
     )(k, v, q, do, lse, delta)
+    dq = dq_part.astype(jnp.float32).sum(axis=1).astype(q.dtype)
     return dq, dk, dv
 
 
@@ -596,8 +578,10 @@ def _flash_bhsd_lse_bwd(causal, block_q, block_k, interpret, seq_len, group,
 _flash_bhsd_lse.defvjp(_flash_bhsd_lse_fwd, _flash_bhsd_lse_bwd)
 
 
-def flash_attention(q, k, v, causal: bool = False, block_q: int = 512,
-                    block_k: int = 1024, interpret: Optional[bool] = None,
+def flash_attention(q, k, v, causal: bool = False,
+                    block_q: Optional[int] = None,
+                    block_k: Optional[int] = None,
+                    interpret: Optional[bool] = None,
                     return_lse: bool = False, backward: str = "auto"):
     """Flash attention over ``(B, S, H, D)`` arrays.
 
@@ -609,14 +593,19 @@ def flash_attention(q, k, v, causal: bool = False, block_q: int = 512,
     the tail masked inside the kernel.  Differentiable via the blockwise
     LSE backward; O(S·block) live memory both directions.
 
-    Default blocks are tuned on TPU v5e: 128×128 leaves the grid too fine
-    (measured ~5× slower at S=1024 — per-cell overhead dominates the two
-    (block_q × d × block_k) MXU issues); 512×1024 amortises it while the
-    fp32 score tile (2 MB) still sits comfortably in VMEM.
+    Default blocks (``block_q/block_k=None``) are tuned on TPU v5e:
+    128×128 leaves the grid too fine (measured ~5× slower at S=1024 —
+    per-cell overhead dominates the two (block_q × d × block_k) MXU
+    issues).  512×1024 amortises it at short S; from S ≥ 2048 the
+    forward measurably prefers 1024×1024 (S=8192: 6.11 → 4.92 ms,
+    docs/PERF.md long-context round 4) and the fp32 score tile (4 MB)
+    still fits VMEM, so the q block widens automatically.  Explicit
+    values are always honored.
 
-    ``backward`` selects the gradient path: ``'pallas'`` — dq and fused
-    dk/dv Pallas kernels (blockwise LSE recompute in VMEM, fp32 scratch
-    accumulators, causal block skipping, GQA group-fold in-scratch);
+    ``backward`` selects the gradient path: ``'pallas'`` — the ONE fused
+    dq/dk/dv kernel (blockwise LSE recompute in VMEM, fp32 dk/dv scratch,
+    input-dtype dq partials + fp32 XLA sum, causal cells skipped with
+    their DMA elided, GQA group-fold in-scratch);
     ``'xla'`` — the lax.scan blockwise recompute; ``'auto'`` — Pallas
     whenever the block geometry is Mosaic-aligned (any S that is a multiple
     of 128 after padding), else XLA.
@@ -640,6 +629,10 @@ def flash_attention(q, k, v, causal: bool = False, block_q: int = 512,
     if v.shape[2] != h_kv:
         raise ValueError(f"k has {h_kv} heads but v has {v.shape[2]}")
     group = h // h_kv
+    if block_q is None:
+        block_q = 1024 if s >= 2048 else 512
+    if block_k is None:
+        block_k = 1024
     block_q = max(block_q, _MIN_BLOCK)
     block_k = max(block_k, _MIN_BLOCK)
     s_pad = s

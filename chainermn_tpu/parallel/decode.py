@@ -418,8 +418,8 @@ def _beam_lazy(params, prompt, embed, attn_block, block_with, global_topk, *,
         return jax.lax.pvary(z, axis_name)
 
     gen = [(varying_zeros((b, k, max_new_tokens, n_kv, head_dim), pk.dtype),
-            varying_zeros((b, k, max_new_tokens, n_kv, head_dim), pk.dtype))
-           for pk, _ in pcaches]
+            varying_zeros((b, k, max_new_tokens, n_kv, head_dim), pv.dtype))
+           for pk, pv in pcaches]
     anc = jnp.zeros((b, k, max_new_tokens), jnp.int32)
     gen_pos = jnp.arange(max_new_tokens)
     slot_ids = jnp.arange(k)
@@ -481,18 +481,10 @@ def _beam_lazy(params, prompt, embed, attn_block, block_with, global_topk, *,
             x, gk, gv = lazy_attn(x, blk, pk, pv, gk, gv, amask, pos, i)
             new_gen.append((gk, gv))
         h = _layer_norm(x, params["lnf_scale"], params["lnf_bias"])
-        v_k, i_k = global_topk(h[:, -1])                         # (B·K, K)
-        cand = scores[:, :, None] + v_k.reshape(b, k, k)
-        flat = cand.reshape(b, k * k)
-        scores, pos_flat = jax.lax.top_k(flat, k)
-        parent = pos_flat // k
-        tokens = jnp.take_along_axis(
-            i_k.reshape(b, k, k).reshape(b, k * k), pos_flat, axis=1
-        ).astype(jnp.int32)
-        # reorder the HISTORY VIEWS, not the caches: token buffer and the
-        # ancestry table (both kilobyte-sized)
-        toks_buf = jnp.take_along_axis(toks_buf, parent[:, :, None], axis=1)
-        toks_buf = toks_buf.at[:, :, i].set(tokens)
+        tokens, scores, toks_buf, parent = _merge_candidates(
+            global_topk, h, scores, toks_buf, i, b, k)
+        # the parents reorder only the ancestry table here (kilobytes) —
+        # never the caches; that is the whole point of the lazy path
         anc = jnp.take_along_axis(anc, parent[:, :, None], axis=1)
         return (tokens, scores, toks_buf, anc, new_gen), None
 

@@ -220,3 +220,32 @@ class TestGQA:
         q, k, v = qkv()
         with pytest.raises(ValueError, match="multiple"):
             flash_attention(q, k[:, :, :3], v[:, :, :3])
+
+
+class TestBf16PartialPrecision:
+    """bf16 inputs route the fused backward's dq partials through a bf16
+    slab (each of the nk per-K-block partials rounds once before the fp32
+    sum).  The error budget is bf16-grade, not fp32-grade — this pins it."""
+
+    def test_bf16_gradients_match_xla_backward(self):
+        rs = np.random.RandomState(7)
+        S = 512  # several K blocks at block_k=128 -> a multi-partial sum
+        q = jnp.asarray(rs.randn(2, S, 4, 64), jnp.bfloat16)
+        k = jnp.asarray(rs.randn(2, S, 4, 64), jnp.bfloat16)
+        v = jnp.asarray(rs.randn(2, S, 4, 64), jnp.bfloat16)
+
+        def grads(backward):
+            def loss(q, k, v):
+                o = flash_attention(q, k, v, causal=True, block_q=128,
+                                    block_k=128, backward=backward)
+                return (o.astype(jnp.float32) ** 2).sum()
+            return jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+        got = grads("pallas")
+        want = grads("xla")
+        for g, w, name in zip(got, want, "qkv"):
+            g = np.asarray(g, np.float32)
+            w = np.asarray(w, np.float32)
+            rel = np.max(np.abs(g - w)) / (np.max(np.abs(w)) + 1e-9)
+            # bf16 grade: one bf16 rounding per partial (~2^-8 relative)
+            assert rel < 2e-2, (name, rel)
