@@ -31,6 +31,7 @@ from .extensions import (  # noqa: F401
     AllreducePersistent,
     ObservationAggregator,
     create_multi_node_checkpointer,
+    multi_node_snapshot,
 )
 from .iterators import (  # noqa: F401
     SerialIterator,

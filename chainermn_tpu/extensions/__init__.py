@@ -6,6 +6,10 @@ from .checkpoint import (  # noqa: F401
     create_multi_node_checkpointer,
     reshard_checkpoint,
 )
+from .multi_node_snapshot import (  # noqa: F401
+    MultiNodeSnapshot,
+    multi_node_snapshot,
+)
 from .observation_aggregator import (  # noqa: F401
     ObservationAggregator,
     aggregate_observations,
@@ -18,6 +22,8 @@ __all__ = [
     "MultiNodeCheckpointer",
     "create_multi_node_checkpointer",
     "reshard_checkpoint",
+    "MultiNodeSnapshot",
+    "multi_node_snapshot",
     "ObservationAggregator",
     "aggregate_observations",
     "Watchdog",

@@ -44,7 +44,6 @@ def timed_ms(fn, x, reps):
 
 def main():
     S = int(sys.argv[1]) if len(sys.argv) > 1 else 8192
-    B = max(1, 16384 // S * 2 // 2)
     B = 2 if S <= 8192 else 1
     H, D = 16, 64
     rs = np.random.RandomState(0)

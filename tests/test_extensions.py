@@ -394,3 +394,89 @@ class TestReshardCheckpoint:
             reshard_checkpoint(str(tmp_path), "job", new_nproc=1,
                                source_process=-1)
         assert reshard_checkpoint(str(tmp_path), "job", new_nproc=1) == 5
+
+
+class TestMultiNodeSnapshot:
+    """Replica-set snapshots (reference merged-era multi_node_snapshot):
+    one shard per replica GROUP, restore fanned out within the group."""
+
+    def _state(self, step):
+        return {"w": np.full((2, 2), float(step)), "step": step}
+
+    def test_roundtrip_writes_one_shard_per_group(self, comm, tmp_path):
+        from chainermn_tpu.extensions import multi_node_snapshot
+
+        cp = create_multi_node_checkpointer("job", comm, path=str(tmp_path))
+        half = comm.size // 2
+        snap = multi_node_snapshot(
+            comm, cp, [list(range(half)), list(range(half, comm.size))])
+        assert snap.maybe_load()[1] is None  # fresh start: no-op
+        snap.save(self._state(3), iteration=3)
+        snap.save(self._state(8), iteration=8)
+        import os
+        files = [f for f in os.listdir(tmp_path) if not f.startswith(".")]
+        # 2 replica sets x 2 generations — NOT comm.size shards per gen
+        assert len(files) == 4, files
+        assert all(".set" in f and f"of2" in f for f in files)
+        loaded, it = snap.maybe_load()
+        assert it == 8
+        np.testing.assert_array_equal(loaded["w"], np.full((2, 2), 8.0))
+
+    def test_unlisted_ranks_become_singletons(self, comm, tmp_path):
+        from chainermn_tpu.extensions import multi_node_snapshot
+
+        cp = create_multi_node_checkpointer("job", comm, path=str(tmp_path))
+        snap = multi_node_snapshot(comm, cp, [[0, 1]])
+        # sets: [0,1] plus a singleton per remaining rank
+        assert len(snap.sets) == comm.size - 1
+        snap.save(self._state(1), iteration=1)
+        import os
+        files = [f for f in os.listdir(tmp_path) if not f.startswith(".")]
+        assert len(files) == comm.size - 1
+
+    def test_overlapping_sets_rejected(self, comm, tmp_path):
+        from chainermn_tpu.extensions import multi_node_snapshot
+
+        cp = create_multi_node_checkpointer("job", comm, path=str(tmp_path))
+        with pytest.raises(ValueError):
+            multi_node_snapshot(comm, cp, [[0, 1], [1, 2]])
+
+    def test_gc_keeps_newest_generations(self, comm, tmp_path):
+        from chainermn_tpu.extensions import multi_node_snapshot
+
+        cp = create_multi_node_checkpointer(
+            "job", comm, gc_interval=3, keep=2, path=str(tmp_path),
+            async_write=False)
+        snap = multi_node_snapshot(comm, cp, [list(range(comm.size))])
+        for it in range(1, 7):
+            snap.save(self._state(it), iteration=it)
+        import os
+        gens = sorted({int(f.split(".iter")[1][:12])
+                       for f in os.listdir(tmp_path)
+                       if not f.startswith(".")})
+        assert len(gens) <= 3 and gens[-1] == 6, gens  # keep=2 (+pre-GC)
+
+    def test_layout_change_fails_loudly(self, comm, tmp_path):
+        from chainermn_tpu.extensions import multi_node_snapshot
+
+        cp = create_multi_node_checkpointer("job", comm, path=str(tmp_path),
+                                            async_write=False)
+        old = multi_node_snapshot(comm, cp, [list(range(comm.size))])
+        old.save(self._state(5), iteration=5)
+        # resume under a DIFFERENT replica layout: shards exist but none
+        # match — must raise, never silently fresh-start
+        new = multi_node_snapshot(
+            comm, cp, [[r] for r in range(comm.size)])
+        with pytest.raises(RuntimeError, match="stale"):
+            new.maybe_load()
+
+    def test_async_save_rides_checkpointer_writer(self, comm, tmp_path):
+        from chainermn_tpu.extensions import multi_node_snapshot
+
+        cp = create_multi_node_checkpointer("job", comm, path=str(tmp_path),
+                                            async_write=True)
+        snap = multi_node_snapshot(comm, cp, [list(range(comm.size))])
+        snap.save(self._state(2), iteration=2)
+        snap.flush()
+        loaded, it = snap.maybe_load()
+        assert it == 2 and loaded["step"] == 2
