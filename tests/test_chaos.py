@@ -16,6 +16,8 @@ import socket
 import subprocess
 import sys
 
+import pytest
+
 _WORKER = os.path.join(os.path.dirname(__file__), "_chaos_worker.py")
 N = 3
 # Passed to the worker on its command line (single source of truth here;
@@ -64,6 +66,7 @@ def _run_gang(phase: str, tmpdir: str):
     return procs, outs
 
 
+@pytest.mark.slow
 def test_crash_then_resume(tmp_path):
     tmpdir = str(tmp_path)
 

@@ -413,6 +413,7 @@ class TestMultiNodeSnapshot:
         assert snap.maybe_load()[1] is None  # fresh start: no-op
         snap.save(self._state(3), iteration=3)
         snap.save(self._state(8), iteration=8)
+        snap.flush()  # saves ride the one-deep async writer
         import os
         files = [f for f in os.listdir(tmp_path) if not f.startswith(".")]
         # 2 replica sets x 2 generations — NOT comm.size shards per gen
@@ -430,6 +431,7 @@ class TestMultiNodeSnapshot:
         # sets: [0,1] plus a singleton per remaining rank
         assert len(snap.sets) == comm.size - 1
         snap.save(self._state(1), iteration=1)
+        snap.flush()  # saves ride the one-deep async writer
         import os
         files = [f for f in os.listdir(tmp_path) if not f.startswith(".")]
         assert len(files) == comm.size - 1

@@ -5,6 +5,7 @@ shapes on the virtual mesh; full-size throughput lives in bench.py.
 """
 
 import jax
+import pytest
 import jax.numpy as jnp
 import numpy as np
 import optax
@@ -25,6 +26,7 @@ def test_resnet50_forward_shapes():
     assert len(variables["params"]) == 16 + 3
 
 
+@pytest.mark.slow
 def test_all_archs_instantiate():
     for name, ctor in ARCHS.items():
         model = ctor(num_classes=4, stem_strides=1)
@@ -34,6 +36,7 @@ def test_all_archs_instantiate():
         assert out.shape == (1, 4), name
 
 
+@pytest.mark.slow
 def test_flax_train_step_learns_and_syncs_bn():
     comm = mn.create_communicator("xla")
     mesh = comm.mesh
@@ -74,6 +77,7 @@ def test_graft_entry_single_chip():
     assert out.shape == (8, 1000)
 
 
+@pytest.mark.slow
 def test_graft_entry_dryrun_multichip():
     import __graft_entry__ as ge
     ge.dryrun_multichip(8)

@@ -60,6 +60,7 @@ def run_loss(mesh, axis_sizes, params, batch, attn_impl="xla"):
 
 
 class TestParity:
+    @pytest.mark.slow
     @pytest.mark.parametrize("attn_impl", ["xla", "flash"])
     def test_tp2_matches_tp1(self, devices, attn_impl):
         """model=2 sharded loss+grads == model=1 (unsharded) oracle."""
@@ -74,6 +75,7 @@ class TestParity:
             np.testing.assert_allclose(
                 np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5)
 
+    @pytest.mark.slow
     def test_loss_is_sane_nll(self, devices):
         """Fresh random LM on uniform tokens → NLL ≈ log(V)."""
         params, batch = params_and_batch()
@@ -110,6 +112,7 @@ class TestSequenceParallelLM:
         loss, grads = jax.value_and_grad(lambda p: fn(p, b))(params)
         return float(loss), grads
 
+    @pytest.mark.slow
     def test_sp8_matches_sp1(self, devices):
         """8-way sequence-sharded loss+grads == unsharded oracle."""
         l1, g1 = self._loss_and_grads(1, "xla", devices)
@@ -120,10 +123,12 @@ class TestSequenceParallelLM:
             np.testing.assert_allclose(
                 np.asarray(a), np.asarray(b), rtol=5e-4, atol=5e-6)
 
+    @pytest.mark.slow
     def test_sane_nll(self, devices):
         l8, _ = self._loss_and_grads(8, "xla", devices)
         assert abs(l8 - np.log(VOCAB)) < 1.5, l8
 
+    @pytest.mark.slow
     def test_ulysses_sp_matches_oracle(self, devices):
         """sp_impl='ulysses' (head↔seq all-to-alls) on 4 shards (HEADS=4
         divisible) == unsharded oracle."""
@@ -180,6 +185,7 @@ class TestGQATransformer:
         assert "wq" in attn and "wkv" in attn and "wqkv" not in attn
         assert attn["wkv"].shape == (D, 2 * 2 * HEAD_DIM)  # 2 kv heads
 
+    @pytest.mark.slow
     @pytest.mark.parametrize("attn_impl", ["xla", "flash"])
     def test_tp2_matches_tp1(self, devices, attn_impl):
         params, batch = self._gqa_params_and_batch()
@@ -252,6 +258,7 @@ class TestRoPE:
         np.testing.assert_allclose(score(7, 3), score(4, 0), rtol=1e-5)
         np.testing.assert_allclose(score(100, 98), score(2, 0), rtol=1e-5)
 
+    @pytest.mark.slow
     def test_tp2_matches_tp1(self, devices):
         params = self._rope_params()
         rng = np.random.RandomState(0)
@@ -294,6 +301,7 @@ class TestRoPE:
 
         np.testing.assert_allclose(run(8), run(1), rtol=1e-5)
 
+    @pytest.mark.slow
     def test_rope_with_gqa(self, devices):
         params = self._rope_params(seed=3, n_kv_heads=2)
         rng = np.random.RandomState(3)
