@@ -89,7 +89,7 @@ def hbm_bw_for(device_kind: str):
 
 
 def build_step(arch, image_size, per_chip_batch, allreduce_grad_dtype=None,
-               double_buffering=False, norm="bn"):
+               double_buffering=False, norm="bn", conv_impl="xla"):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -105,6 +105,8 @@ def build_step(arch, image_size, per_chip_batch, allreduce_grad_dtype=None,
     global_batch = per_chip_batch * n_chips
 
     kw = {"norm": norm} if norm != "bn" else {}
+    if conv_impl != "xla":
+        kw["conv_impl"] = conv_impl
     model = ARCHS[arch](stem_strides=2 if image_size >= 64 else 1, **kw)
     variables = dict(model.init(
         jax.random.PRNGKey(0), jnp.zeros((1, image_size, image_size, 3)),

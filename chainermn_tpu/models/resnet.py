@@ -20,6 +20,8 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from chainermn_tpu.ops import conv_backward
+
 ModuleDef = Any
 
 
@@ -128,20 +130,53 @@ def make_norm(norm: str, train: bool, dtype):
     raise ValueError(f"unknown norm {norm!r}")
 
 
+class PallasConv(nn.Module):
+    """nn.Conv(use_bias=False) stand-in whose VJP runs the Pallas 3x3
+    backward kernels (ops/conv_backward.py).  Same param name ("kernel"),
+    shape (kh, kw, cin, features) and default init as nn.Conv, so
+    checkpoints are interchangeable with the XLA path when call sites pin
+    the module name."""
+
+    features: int
+    kernel: Tuple[int, int] = (3, 3)
+    strides: Any = 1
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        kh, kw = self.kernel
+        s = self.strides[0] if isinstance(self.strides, tuple) else self.strides
+        w = self.param("kernel", nn.initializers.lecun_normal(),
+                       (kh, kw, x.shape[-1], self.features), jnp.float32)
+        return conv_backward.conv2d(x.astype(self.dtype),
+                                    w.astype(self.dtype), s)
+
+
+def _conv3x3_factory(conv_impl: str, dtype):
+    """The 3x3 conv used inside blocks: XLA end to end, or XLA forward with
+    the Pallas traffic-floor backward (conv_impl='pallas')."""
+    if conv_impl == "pallas":
+        return partial(PallasConv, dtype=dtype)
+    return partial(nn.Conv, use_bias=False, dtype=dtype)
+
+
 class BasicBlock(nn.Module):
     filters: int
     strides: int = 1
     dtype: Any = jnp.bfloat16
     norm: str = "bn"
+    conv_impl: str = "xla"
 
     @nn.compact
     def __call__(self, x, train: bool = True):
         norm = make_norm(self.norm, train, self.dtype)
         conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        conv3 = _conv3x3_factory(self.conv_impl, self.dtype)
         residual = x
-        y = conv(self.filters, (3, 3), strides=(self.strides, self.strides))(x)
+        y = conv3(self.filters, (3, 3), strides=(self.strides, self.strides),
+                  name="Conv_0")(x)
         y = nn.relu(norm()(y))
-        y = conv(self.filters, (3, 3))(y)
+        y = conv3(self.filters, (3, 3), name="Conv_1")(y)
         # zero-init the last BN scale so each block starts as identity —
         # standard large-batch ResNet trick (Goyal et al.), matters at the
         # batch sizes DP scaling targets
@@ -159,18 +194,21 @@ class BottleneckBlock(nn.Module):
     strides: int = 1
     dtype: Any = jnp.bfloat16
     norm: str = "bn"
+    conv_impl: str = "xla"
 
     @nn.compact
     def __call__(self, x, train: bool = True):
         norm = make_norm(self.norm, train, self.dtype)
         conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        conv3 = _conv3x3_factory(self.conv_impl, self.dtype)
         residual = x
-        y = nn.relu(norm()(conv(self.filters, (1, 1))(x)))
+        y = nn.relu(norm()(conv(self.filters, (1, 1), name="Conv_0")(x)))
         # v1.5: stride lives on the 3x3, not the 1x1
-        y = nn.relu(norm()(conv(self.filters, (3, 3),
-                                strides=(self.strides, self.strides))(y)))
+        y = nn.relu(norm()(conv3(self.filters, (3, 3),
+                                 strides=(self.strides, self.strides),
+                                 name="Conv_1")(y)))
         y = norm(scale_init=nn.initializers.zeros)(
-            conv(self.filters * 4, (1, 1))(y))
+            conv(self.filters * 4, (1, 1), name="Conv_2")(y))
         if residual.shape != y.shape:
             residual = conv(self.filters * 4, (1, 1),
                             strides=(self.strides, self.strides),
@@ -187,6 +225,7 @@ class ResNet(nn.Module):
     dtype: Any = jnp.bfloat16
     stem_strides: int = 2  # small-image variants (CIFAR-style) can use 1
     norm: str = "bn"  # 'bn' | 'stalebn' (fused-epilogue stats) | 'affine'
+    conv_impl: str = "xla"  # 'xla' | 'pallas' (traffic-floor 3x3 backward)
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -204,7 +243,8 @@ class ResNet(nn.Module):
                 strides = 2 if i > 0 and j == 0 else 1
                 x = self.block_cls(self.num_filters * 2 ** i,
                                    strides=strides, dtype=self.dtype,
-                                   norm=self.norm)(x, train)
+                                   norm=self.norm,
+                                   conv_impl=self.conv_impl)(x, train)
         x = jnp.mean(x, axis=(1, 2))
         # head in float32: the tiny matmul costs nothing, the logits gain
         # a lot of precision
@@ -240,6 +280,7 @@ class ScaledWSConv(nn.Module):
     strides: int = 1
     dtype: Any = jnp.bfloat16
     padding: Any = "SAME"
+    conv_impl: str = "xla"
 
     @nn.compact
     def __call__(self, x):
@@ -253,6 +294,14 @@ class ScaledWSConv(nn.Module):
         var = w.var((0, 1, 2), keepdims=True)
         fan_in = kh * kw * cin
         w_hat = (w - mu) * jax.lax.rsqrt(var * fan_in + 1e-4) * gain
+        if self.conv_impl == "pallas" and self.padding == "SAME":
+            # Pallas backward for every eligible conv (stride-1 3x3 AND
+            # 1x1 on planes >= 14x14 — see _eligible); conv2d falls back
+            # to the XLA transpose only for stride-2 / tiny planes, so
+            # routing every SAME conv through it is behavior-safe.
+            return conv_backward.conv2d(x.astype(self.dtype),
+                                        w_hat.astype(self.dtype),
+                                        self.strides)
         return jax.lax.conv_general_dilated(
             x.astype(self.dtype), w_hat.astype(self.dtype),
             (self.strides, self.strides), self.padding,
@@ -269,10 +318,12 @@ class NFBottleneckBlock(nn.Module):
     strides: int = 1
     alpha: float = 0.2
     dtype: Any = jnp.bfloat16
+    conv_impl: str = "xla"
 
     @nn.compact
     def __call__(self, x):
-        conv = partial(ScaledWSConv, dtype=self.dtype)
+        conv = partial(ScaledWSConv, dtype=self.dtype,
+                       conv_impl=self.conv_impl)
         act = lambda v: nn.relu(v) * GAMMA_RELU  # noqa: E731
         out = act(x / self.beta)
         if self.strides > 1 or x.shape[-1] != self.filters * 4:
@@ -308,6 +359,7 @@ class NFResNet(nn.Module):
     alpha: float = 0.2
     dtype: Any = jnp.bfloat16
     stem_strides: int = 2
+    conv_impl: str = "xla"
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -328,7 +380,8 @@ class NFResNet(nn.Module):
                 x = NFBottleneckBlock(
                     self.num_filters * 2 ** i,
                     beta=float(expected_var) ** 0.5, strides=strides,
-                    alpha=self.alpha, dtype=self.dtype)(x)
+                    alpha=self.alpha, dtype=self.dtype,
+                    conv_impl=self.conv_impl)(x)
                 expected_var = (1.0 if transition else expected_var) \
                     + self.alpha ** 2
         x = jnp.mean(x, axis=(1, 2))

@@ -1,0 +1,45 @@
+"""A/B the Pallas 3x3 conv backward against XLA inside the full train step.
+
+The per-op probe (probe_conv_bwd.py) attributes bytes; this is the decision
+metric: end-to-end step time of ResNet-50 / NF-ResNet-50 at the bench
+headline config with conv_impl='xla' vs 'pallas'.
+
+Usage: python scripts/ab_conv_impl.py [--arch nf_resnet50] [--batch 128]
+"""
+
+import argparse
+import sys
+
+sys.path.insert(0, ".")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--arch", default="nf_resnet50")
+    ap.add_argument("--batch", type=int, default=128)
+    ap.add_argument("--steps", type=int, default=40)
+    ap.add_argument("--image-size", type=int, default=224)
+    args = ap.parse_args()
+
+    from bench import build_step, compile_with_flops, measure
+
+    for impl in ("xla", "pallas"):
+        step, variables, opt_state, batch, n_chips, global_batch = build_step(
+            args.arch, args.image_size, args.batch, conv_impl=impl)
+        compiled, flops, nbytes = compile_with_flops(
+            step, variables, opt_state, batch)
+        if compiled is step:  # compile_with_flops falls back to the raw step
+            print(f"{impl}: AOT compile FAILED")
+            continue
+        dt, loss = measure(compiled, variables, opt_state, batch, args.steps)
+        step_ms = dt / args.steps * 1e3
+        ips = global_batch * args.steps / dt / n_chips
+        print(f"{impl:7s}: {step_ms:7.2f} ms/step  {ips:8.1f} img/s/chip  "
+              f"loss {loss:.4f}  "
+              f"bytes/step {nbytes/1e9 if nbytes else float('nan'):.2f} GB  "
+              f"flops/step {flops/1e12 if flops else float('nan'):.2f} TF",
+              flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -109,6 +109,13 @@ def load_dir(path):
                 f"images must share one shape; {fp} is {arr.shape}, "
                 f"expected {first.shape} — resize offline first "
                 "(records are fixed-size)")
+        if arr.dtype != first.dtype:
+            # the implicit cast in `images[n] = arr` would silently corrupt
+            # mixed corpora (float [0,1] scans truncating to uint8 zeros)
+            raise SystemExit(
+                f"images must share one dtype; {fp} is {arr.dtype}, "
+                f"expected {first.dtype} — convert offline first "
+                "(source dtype is preserved in the records)")
         if images is None:
             images = np.empty((len(files),) + first.shape, first.dtype)
         images[n] = arr
