@@ -714,7 +714,10 @@ def main():
     # respect a wall-clock budget, and the scaling sweep is gated
     # per-point.
     t_start = time.time()
-    budget_s = float(os.environ.get("BENCH_BUDGET_S", 1200))
+    # 1100 s budget lands the default run at ~18.5 min wall (measured
+    # 20m03s at 1200 s, round 4) — margin under any plausible driver
+    # timeout; every section still completed within it.
+    budget_s = float(os.environ.get("BENCH_BUDGET_S", 1100))
 
     def over_budget():
         return time.time() - t_start > budget_s

@@ -24,9 +24,14 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
 
 def main():
     parser = argparse.ArgumentParser(description="ChainerMN-TPU example: ImageNet")
+    # Kept as a literal (not ARCHS.keys()): the registry import pulls in
+    # jax, which must wait until --devices is applied.  A consistency
+    # assert below catches drift.
     parser.add_argument("--arch", default="resnet50",
                         choices=["resnet18", "resnet34", "resnet50",
                                  "resnet101", "resnet152",
+                                 "nf_resnet50", "nf_resnet101",
+                                 "nf_resnet152",
                                  "alex", "googlenet", "vgg16",
                                  "vit_ti16", "vit_s16", "vit_b16"])
     parser.add_argument("--devices", type=int, default=0,
@@ -57,6 +62,13 @@ def main():
                         help="wire dtype for the cross-chip gradient mean "
                              "(reference: pure_nccl allreduce_grad_dtype; "
                              "int8 = quantized ring, beyond-reference)")
+    parser.add_argument("--conv-impl", default="xla",
+                        choices=["xla", "pallas"],
+                        help="3x3/1x1 conv backward impl. 'pallas' is the "
+                             "measured-SLOWER opt-in kernel path kept for "
+                             "the record (docs/PERF.md 'Conv backward: why "
+                             "the Pallas kernels lost'); default XLA runs "
+                             "at the HBM floor")
     parser.add_argument("--norm", default="bn",
                         choices=["bn", "stalebn", "affine"],
                         help="ResNet norm layer. For the MEASURED BN-free "
@@ -88,6 +100,9 @@ def main():
     from chainermn_tpu.models.mlp import cross_entropy_loss
     from chainermn_tpu.models.resnet import ARCHS
 
+    assert args.arch in ARCHS, (
+        f"--arch choices drifted from the model registry: {args.arch!r} "
+        f"not in {sorted(ARCHS)}")
     mn.init_distributed()
     comm = mn.create_communicator(args.communicator)
     mesh = getattr(comm, "mesh", None) or mn.make_mesh()
@@ -100,6 +115,10 @@ def main():
     arch_kw = {"norm": args.norm} if args.norm != "bn" else {}
     if arch_kw and not args.arch.startswith("resnet"):
         parser.error("--norm applies to the resnet archs only")
+    if args.conv_impl != "xla":
+        if "resnet" not in args.arch:
+            parser.error("--conv-impl applies to the (nf_)resnet archs only")
+        arch_kw["conv_impl"] = args.conv_impl
     model = ARCHS[args.arch](num_classes=args.num_classes,
                              stem_strides=2 if args.image_size >= 64 else 1,
                              **arch_kw)

@@ -275,3 +275,31 @@ class TestDecodeOnChip:
         b = np.asarray(sampled(params, prompt, jax.random.PRNGKey(1)))
         np.testing.assert_array_equal(a, b)
         assert ((a >= 0) & (a < 64)).all()
+
+
+class TestCompiledConvBackward:
+    """Mosaic-compiled conv backward kernels vs the XLA transpose oracle.
+
+    The interpret-mode parity suite (tests/test_conv_backward.py) checks
+    the math anywhere; this checks the COMPILED kernels on the real chip —
+    the path conv_impl='pallas' takes (docs/PERF.md records why it stays
+    opt-in)."""
+
+    def test_wgrad_dgrad_match_xla(self):
+        from chainermn_tpu.ops.conv_backward import (
+            _xla_conv, conv3x3_dgrad, conv3x3_wgrad)
+
+        k1, k2, k3 = jax.random.split(jax.random.PRNGKey(0), 3)
+        x = jax.random.normal(k1, (16, 28, 28, 128), jnp.bfloat16)
+        w = jax.random.normal(k2, (3, 3, 128, 128), jnp.bfloat16)
+        dy = jax.random.normal(k3, (16, 28, 28, 128), jnp.bfloat16)
+        _, vjp = jax.vjp(lambda x, w: _xla_conv(x, w, 1), x, w)
+        ex, ew = vjp(dy)
+        dx = jax.jit(lambda dy, w: conv3x3_dgrad(dy, w, x.shape, 1))(dy, w)
+        dw = jax.jit(lambda x, dy: conv3x3_wgrad(x, dy, 1))(x, dy)
+        np.testing.assert_allclose(
+            np.asarray(dx, np.float32), np.asarray(ex, np.float32),
+            rtol=0.1, atol=0.25)  # bf16 oracle accumulates in its own order
+        np.testing.assert_allclose(
+            np.asarray(dw, np.float32), np.asarray(ew, np.float32),
+            rtol=0.1, atol=2.0)
