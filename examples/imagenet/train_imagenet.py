@@ -84,6 +84,16 @@ def main():
                              "a ViT, e.g. --arch vit_s16)")
     args = parser.parse_args()
 
+    # Flag-combination checks that need nothing from jax: fail fast,
+    # before device config / distributed init.
+    arch_kw = {"norm": args.norm} if args.norm != "bn" else {}
+    if arch_kw and not args.arch.startswith("resnet"):
+        parser.error("--norm applies to the resnet archs only")
+    if args.conv_impl != "xla":
+        if "resnet" not in args.arch:
+            parser.error("--conv-impl applies to the (nf_)resnet archs only")
+        arch_kw["conv_impl"] = args.conv_impl
+
     if args.devices:
         import jax
         jax.config.update("jax_platforms", "cpu")
@@ -100,9 +110,13 @@ def main():
     from chainermn_tpu.models.mlp import cross_entropy_loss
     from chainermn_tpu.models.resnet import ARCHS
 
-    assert args.arch in ARCHS, (
-        f"--arch choices drifted from the model registry: {args.arch!r} "
-        f"not in {sorted(ARCHS)}")
+    # Drift guard over the FULL choices list (not just the picked arch),
+    # with a real raise — an assert is stripped under python -O.
+    missing = [c for c in parser._option_string_actions["--arch"].choices
+               if c not in ARCHS]
+    if missing:
+        parser.error(f"--arch choices drifted from the model registry: "
+                     f"{missing} not in {sorted(ARCHS)}")
     mn.init_distributed()
     comm = mn.create_communicator(args.communicator)
     mesh = getattr(comm, "mesh", None) or mn.make_mesh()
@@ -112,13 +126,6 @@ def main():
         print(f"{args.arch}  chips={n_chips}  global_batch={global_batch}  "
               f"image={args.image_size}")
 
-    arch_kw = {"norm": args.norm} if args.norm != "bn" else {}
-    if arch_kw and not args.arch.startswith("resnet"):
-        parser.error("--norm applies to the resnet archs only")
-    if args.conv_impl != "xla":
-        if "resnet" not in args.arch:
-            parser.error("--conv-impl applies to the (nf_)resnet archs only")
-        arch_kw["conv_impl"] = args.conv_impl
     model = ARCHS[args.arch](num_classes=args.num_classes,
                              stem_strides=2 if args.image_size >= 64 else 1,
                              **arch_kw)

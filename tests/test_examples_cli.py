@@ -1,0 +1,72 @@
+"""Every example CLI runs end to end on the virtual mesh.
+
+Reachability guard (SURVEY.md §2.9): the reference shipped runnable
+examples, and a flag the docs advertise must actually parse and train.
+Round 4 found `--arch nf_resnet50` advertised everywhere but rejected by
+the imagenet CLI's choices list — this matrix makes that class of drift a
+test failure.
+
+Each case is a subprocess on the 8-device virtual CPU mesh with tiny
+shapes.  The whole matrix is slow-tier (each run pays a fresh jax import
++ compile, ~30-90 s on a 1-core host); `test_example_cli_smoke` in
+test_train_mnist.py keeps one case in the fast tier.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.join(os.path.dirname(__file__), "..")
+
+CASES = [
+    ("mnist/train_mnist_checkpoint.py",
+     ["--epoch", "1", "--batchsize", "16", "--unit", "32"]),
+    ("imagenet/train_imagenet.py",
+     ["--image-size", "16", "--batchsize", "4", "--steps", "2",
+      "--dataset-size", "64", "--num-classes", "10", "--arch", "resnet18"]),
+    ("imagenet/train_imagenet.py",
+     ["--image-size", "16", "--batchsize", "4", "--steps", "2",
+      "--dataset-size", "64", "--num-classes", "10",
+      "--arch", "nf_resnet50"]),
+    ("seq2seq/seq2seq.py",
+     ["--epoch", "1", "--batchsize", "8", "--unit", "32", "--vocab", "64",
+      "--n-train", "64", "--n-val", "16"]),
+    ("model_parallel/train_model_parallel.py",
+     ["--steps", "2", "--hidden", "32"]),
+    ("hybrid_parallel/train_hybrid.py",
+     ["--tp", "2", "--d-model", "32", "--d-hidden", "64",
+      "--batchsize", "8", "--steps", "2"]),
+    ("transformer/train_transformer.py",
+     ["--tp", "2", "--vocab", "64", "--d-model", "32", "--n-heads", "4",
+      "--n-layers", "2", "--seq-len", "16", "--batchsize", "4",
+      "--steps", "2"]),
+    ("long_context/train_long_context.py",
+     ["--vocab", "64", "--d-model", "32", "--n-heads", "4",
+      "--n-layers", "2", "--seq-len", "64", "--batchsize", "2",
+      "--steps", "2"]),
+    ("moe/train_moe.py",
+     ["--d-in", "16", "--d-model", "32", "--d-hidden", "64",
+      "--num-classes", "4", "--batchsize", "8", "--steps", "2"]),
+    ("generate/generate.py",
+     ["--tp", "2", "--vocab", "64", "--d-model", "32", "--n-heads", "4",
+      "--kv-heads", "2", "--n-layers", "2", "--seq-len", "32",
+      "--steps", "2", "--prompt-len", "4", "--max-new-tokens", "4",
+      "--pos-impl", "rope"]),
+]
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize(
+    "script,args", CASES,
+    ids=[f"{c[0].split('/')[0]}-{i}" for i, c in enumerate(CASES)])
+def test_example_cli_runs(script, args):
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "examples", script),
+         "--devices", "8", *args],
+        capture_output=True, text=True, timeout=600, env=env, cwd=ROOT)
+    assert out.returncode == 0, (script, out.stderr[-2000:])
