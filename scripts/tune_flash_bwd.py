@@ -60,8 +60,13 @@ def main():
                                    block_q=bq, block_k=bk)
 
         def fb(c, bq=bq, bk=bk):
+            # Sweep the BACKWARD blocks too: since the late-round-4
+            # decoupling, the backward no longer reads the forward's
+            # blocks, so a forward-only sweep would time the fixed
+            # bwd default at every point.
             o, vjp = jax.vjp(lambda a: flash_attention(
-                a, a, a, causal=True, block_q=bq, block_k=bk), c)
+                a, a, a, causal=True, block_q=bq, block_k=bk,
+                bwd_block_q=bq, bwd_block_k=bk), c)
             (dq,) = vjp(o)
             return dq
 
