@@ -77,6 +77,12 @@ def main():
                              "'stalebn'/'affine' are perf-probe knobs — "
                              "stalebn DIVERGES in training "
                              "(docs/evidence_stalebn_divergence.json)")
+    parser.add_argument("--agc", type=float, default=0.0,
+                        help="adaptive gradient clipping threshold (0 = "
+                             "off). The NF-ResNet large-batch ingredient "
+                             "(use ~0.01 from global batch ~4096, Brock "
+                             "et al. 2021); composes optax.adaptive_grad_"
+                             "clip ahead of the optimizer")
     parser.add_argument("--communicator", default="xla")
     parser.add_argument("--fsdp", action="store_true",
                         help="ZeRO-3: params, grads and optimizer state all "
@@ -93,6 +99,10 @@ def main():
         if "resnet" not in args.arch:
             parser.error("--conv-impl applies to the (nf_)resnet archs only")
         arch_kw["conv_impl"] = args.conv_impl
+    if args.agc < 0:
+        # optax.adaptive_grad_clip(-x) silently negates every update
+        # (gradient ascent) — reject rather than diverge.
+        parser.error("--agc must be >= 0")
 
     if args.devices:
         import jax
@@ -149,6 +159,12 @@ def main():
             optax.add_decayed_weights(args.weight_decay),
             optax.sgd(lr, momentum=args.momentum),
         )
+    if args.agc:
+        # NF-ResNet's large-batch ingredient (Brock et al.: needed from
+        # batch ~4096): per-unit ratio clip BEFORE the optimizer, after
+        # the gradient mean (create_multi_node_optimizer wraps the whole
+        # chain, so the clip sees synchronized gradients).
+        inner = optax.chain(optax.adaptive_grad_clip(args.agc), inner)
     if not args.fsdp:
         optimizer = mn.create_multi_node_optimizer(
             inner,
