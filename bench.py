@@ -166,26 +166,34 @@ def compile_with_flops(step, variables, opt_state, batch):
     return compiled, flops, nbytes
 
 
-def measure(step, variables, opt_state, batch, steps):
-    """Two timing epochs, report the slower; timing ends at a HOST READBACK.
+def measure(step, variables, opt_state, batch, steps, epochs=2,
+            reduce="max"):
+    """Timing epochs ending at a HOST READBACK; report max or median.
 
     Empirically (probed on the axon TPU tunnel) ``block_until_ready`` can
     return long before the work is done — even on the full output tree —
     inflating throughput by 100x+.  ``float(loss)`` cannot lie: the scalar
     must physically exist on the host, and each step's params feed the
     next, so the final loss transitively depends on every timed step.
-    Two epochs + max(dt) additionally guard against first-loop artifacts.
+
+    ``reduce="max"`` (default, 2 epochs) guards against first-loop
+    artifacts for the honest-headline sections; the scaling sweep uses
+    ``reduce="median"`` with 3 epochs so a single scheduler hiccup on the
+    time-shared virtual mesh cannot publish a >100% efficiency point
+    (round-4 artifact carried a single-sample 116.9%).
     """
     for _ in range(2):  # compile + warmup
         variables, opt_state, loss, *_ = step(variables, opt_state, batch)
     float(loss)
-    dt, out = 0.0, 0.0
-    for _ in range(2):
+    dts, out = [], 0.0
+    for _ in range(epochs):
         t0 = time.perf_counter()
         for _ in range(steps):
             variables, opt_state, loss, *_ = step(variables, opt_state, batch)
         out = float(loss)  # host readback = the timing barrier
-        dt = max(dt, time.perf_counter() - t0)
+        dts.append(time.perf_counter() - t0)
+    dts.sort()
+    dt = dts[-1] if reduce == "max" else dts[len(dts) // 2]
     return dt, out
 
 
@@ -497,7 +505,10 @@ def scaling_worker(n, grad_dtype=None, double_buffering=False):
         double_buffering=double_buffering)
     assert n_chips == n, (n_chips, n)
     steps = 3 if n <= 8 else 2
-    dt, _ = measure(step, variables, opt_state, batch, steps=steps)
+    # median-of-3: a single-sample point on a time-shared host published a
+    # 116.9% efficiency in BENCH_r04.json — noise, but it reads as a claim.
+    dt, _ = measure(step, variables, opt_state, batch, steps=steps,
+                    epochs=3, reduce="median")
     out = {"n": n, "total_ips": steps * global_batch / dt,
            "step_ms": dt / steps * 1e3}
 
@@ -532,14 +543,19 @@ def scaling_worker(n, grad_dtype=None, double_buffering=False):
     print(json.dumps(out))
 
 
-def run_scaling_sweep(ns=(1, 2, 4, 8), over_budget=None, budget_left=None):
+def run_scaling_sweep(ns=(1, 4, 8), over_budget=None, budget_left=None):
     """Weak-scaling sweep in fresh CPU subprocesses (platform is per-process).
 
     Reports per-point efficiency vs n=1 and the measured gradient-pmean
     time, plus two extra n=8 points so the reference's v1.2 headline
     features (SURVEY.md §6) each have a recorded number: a COMPRESSED
     point (bf16 wire, ``compressed_bf16_n8``) and a DOUBLE-BUFFERED point
-    (1-step-stale overlap, ``double_buffered_n8``).
+    (1-step-stale overlap, ``double_buffered_n8``).  The extras run
+    immediately after the n=1 base — BEFORE the remaining plain points —
+    because in round 4 they ran last and the budget gate nulled them out
+    of the official artifact (VERDICT round-4, Missing #2).  Each point is
+    the MEDIAN of 3 timing epochs (see ``measure``), and n=2 moved behind
+    ``--full-sweep`` to pay for the extra epochs.
 
     Default tops out at n=8: docs/SCALING.md shows the n=16/32 tail
     measures single-core XLA host scheduling, not interconnect, and its
@@ -589,21 +605,27 @@ def run_scaling_sweep(ns=(1, 2, 4, 8), over_budget=None, budget_left=None):
                 p[k] = round(p[k], 1)
         return p
 
-    points = {}
+    # Order (round-5 directive): the n=1 base, then the two reference-v1.2
+    # headline extras (compressed bf16 wire, double-buffered overlap) so
+    # they land in the driver artifact even if the budget later runs out —
+    # in round 4 they ran LAST and were both null purely for budget —
+    # then the remaining plain points.
+    points = {"1": run_point(1)} if not over_budget() else {}
+    base = (points.get("1") or {}).get("total_ips")
+    compressed = (finalize_point(run_point(8, grad_dtype="bfloat16"), base)
+                  if base and not over_budget() else None)
+    double_buf = (finalize_point(run_point(8, double_buffering=True), base)
+                  if base and not over_budget() else None)
     for n in ns:
+        if str(n) in points:
+            continue
         if over_budget():
             print(f"bench: over budget — scaling sweep stops before n={n}",
                   file=sys.stderr)
             break
         points[str(n)] = run_point(n)
-    base = (points.get("1") or {}).get("total_ips")
     for p in points.values():
         finalize_point(p, base)
-    extras_ok = "8" in points and not over_budget()
-    compressed = (finalize_point(run_point(8, grad_dtype="bfloat16"), base)
-                  if extras_ok else None)
-    double_buf = (finalize_point(run_point(8, double_buffering=True), base)
-                  if extras_ok and not over_budget() else None)
     eff8 = (points.get("8") or {}).get("eff_pct")
     try:
         cores = os.cpu_count()
@@ -890,16 +912,69 @@ def main():
         "wall_clock_s": None,
     }
 
+    def compact_line():
+        """One ≤1200-byte summary with the same driver schema (metric/
+        value/unit/vs_baseline) plus the key per-section scalars.
+
+        Round-5 ante (VERDICT round-4, What's weak #1): the enriched line
+        grew to ~8 KB while the driver keeps only a 2000-char stdout TAIL,
+        so rc=0 runs still parsed to null for two rounds running.  This
+        line is printed AFTER every enriched emit, so the last complete
+        JSON line in any tail window is always this one.
+        """
+        g = lambda d, *ks: (  # noqa: E731 — safe nested dict walk
+            g(d[ks[0]], *ks[1:]) if ks and isinstance(d, dict)
+            and d.get(ks[0]) is not None else (d if not ks else None))
+        c = {
+            "metric": result["metric"],
+            "value": result["value"],
+            "unit": result["unit"],
+            "vs_baseline": result["vs_baseline"],
+            "mfu": result["mfu"],
+            "mfu_useful": result["mfu_useful"],
+            "suspect": result["suspect"],
+            "compact": True,
+            "nf_resnet_ips": g(result, "nf_resnet50", "img_per_sec_per_chip"),
+            "nf_resnet_mfu_useful": g(result, "nf_resnet50", "mfu_useful"),
+            "lm_mfu": g(result, "transformer_lm", "mfu_useful"),
+            "lm_large_mfu": g(result, "transformer_lm_large", "mfu_useful"),
+            "decode_greedy_ms_tok": g(result, "decode",
+                                      "greedy_ms_per_token"),
+            "decode_beam4_ms_tok": g(result, "decode", "beam4_ms_per_token"),
+            "flash_s8192_mfu": g(result, "long_context",
+                                 "flash_fwd_bwd_S8192", "attn_mfu"),
+            "flash_s16384_mfu": g(result, "long_context",
+                                  "flash_fwd_bwd_S16384", "attn_mfu"),
+            "data_assembly_ips_disk": g(result, "data_path",
+                                        "assembly_ips_disk"),
+            "scaling_eff8_pct": g(result, "scaling", "efficiency_pct"),
+            "compressed_bf16_n8_eff": g(result, "scaling",
+                                        "compressed_bf16_n8", "eff_pct"),
+            "double_buffered_n8_eff": g(result, "scaling",
+                                        "double_buffered_n8", "eff_pct"),
+            "sections_complete": result["sections_complete"],
+            "wall_clock_s": result["wall_clock_s"],
+        }
+        line = json.dumps(c)
+        if len(line) > 1200:  # never let the compact line outgrow the tail
+            for k in ("sections_complete", "data_assembly_ips_disk",
+                      "flash_s16384_mfu"):
+                c.pop(k, None)
+            line = json.dumps(c)
+        return line
+
     def emit(section=None):
-        """Re-print the FULL result line; ``section`` is recorded in
-        ``sections_complete`` only when it actually SUCCEEDED (callers pass
-        it after the result field is assigned; failed sections re-emit with
-        no section so a null field is never advertised as complete)."""
+        """Re-print the FULL result line, then the COMPACT summary line;
+        ``section`` is recorded in ``sections_complete`` only when it
+        actually SUCCEEDED (callers pass it after the result field is
+        assigned; failed sections re-emit with no section so a null field
+        is never advertised as complete)."""
         if section and section not in result["sections_complete"]:
             result["sections_complete"].append(section)
         result["suspect"] = suspect
         result["wall_clock_s"] = round(time.time() - t_start, 1)
         print(json.dumps(result), flush=True)
+        print(compact_line(), flush=True)
 
     emit("headline")
 
@@ -993,7 +1068,7 @@ def main():
 
     # --- DP weak-scaling sweep (virtual CPU mesh, fresh subprocesses) ------
     if not args.skip_scaling and not over_budget():
-        ns = (1, 2, 4, 8, 16, 32) if args.full_sweep else (1, 2, 4, 8)
+        ns = (1, 2, 4, 8, 16, 32) if args.full_sweep else (1, 4, 8)
         budget_left = lambda: budget_s - (time.time() - t_start)  # noqa: E731
         result["scaling"] = run_scaling_sweep(
             ns, over_budget=over_budget, budget_left=budget_left)

@@ -63,15 +63,13 @@ from jax.experimental.pallas import tpu as pltpu
 __all__ = ["conv2d", "conv3x3_dgrad", "conv3x3_wgrad"]
 
 _VMEM_BUDGET = 5 * 1024 * 1024  # headroom under the 16 MB/core scoped
-# limit: measured scoped-stack usage runs ~2x the nominal block estimate
-# (Mosaic keeps roll/cast/mask transients and double-buffered IO live), so
-# the budget is set to half of a conservative target.  bn=1 on the 56x56
-# stage still gives >3000 contraction rows per dot — MXU-efficient.
 # limit: the pipeline double-buffers input/output blocks, and Mosaic's
-# stack holds the rolled fp32 copy, its border mask and the
-# bf16 cast LIVE simultaneously with inputs and the accumulator, so the
-# per-image estimates below charge ~16 bytes/pixel for the rolled operand
-# (2 in + 4 cast + 4 roll + 4 mask + 2 re-cast), not its nominal 2.
+# stack holds the rolled fp32 copy, its border mask and the bf16 cast LIVE
+# simultaneously with inputs and the accumulator — so the per-image
+# estimates below charge ~16 bytes/pixel for the rolled operand
+# (2 in + 4 cast + 4 roll + 4 mask + 2 re-cast), not its nominal 2, and
+# the budget is set to ~half of a conservative target.  bn=1 on the 56x56
+# stage still gives >3000 contraction rows per dot — MXU-efficient.
 
 
 def _inherit_vma(*xs) -> frozenset:

@@ -91,6 +91,22 @@ def _pick_aligned_block(s: int, want: int) -> int:
     return 0
 
 
+def _pick_lane_block(s: int, want: int) -> int:
+    """Largest LANE-multiple (128) divisor of ``s`` ≤ ``want`` — the
+    backward's Pallas kernels slice (1, 1, S) LSE/delta rows at lane-dim
+    offset iq·block_q, which compiled Mosaic requires 128-aligned, so the
+    q-block must be a 128-multiple.  Preferring 128-multiple divisors keeps
+    shapes like S=640 (→128) and S=1280 (→256) on the Pallas path where the
+    plain 8-aligned pick would return 320 and silently fall back to the XLA
+    scan (round-4 advisor finding).  Falls back to the 8-aligned pick when
+    no 128-multiple divisor exists (the dispatch check then routes to XLA).
+    """
+    for b in range(min(want, s) // _LANES * _LANES, 0, -_LANES):
+        if s % b == 0:
+            return b
+    return _pick_aligned_block(s, want)
+
+
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
                 acc_ref, m_ref, l_ref, *, scale, causal,
                 block_q, block_k, num_kblocks, seq_len):
@@ -497,17 +513,27 @@ def _bwd_dispatch(q, k, v, out, lse, do, causal, scale, block_q, block_k,
     differ, and an explicit value is honored even when finer than the
     default."""
     s = q.shape[1]
-    pick = _pick_block if interpret else _pick_aligned_block
     # Backward blocks are INDEPENDENT of the forward's: the optima differ
     # (S=16384: fwd wants 1024x1024, bwd wants 512x2048 — 19% apart), so
     # callers' forward tuning no longer drags the backward with it.
     # Explicit bwd_block_q/bwd_block_k on flash_attention override.
-    bwd_bq = pick(s, bwd_block_q or _BWD_BLOCK_Q)
-    bwd_bk = pick(s, bwd_block_k or _BWD_BLOCK_K)
+    # Default q block: prefer 128-multiple divisors (lane-aligned LSE
+    # slices, see below).  An EXPLICIT bwd_block_q keeps the plain
+    # 8-aligned pick so the caller's value is honored verbatim — and a
+    # non-lane explicit block still fails loudly on backward='pallas'
+    # instead of being silently swapped for a smaller tile.
+    bwd_bq = (_pick_block if interpret else
+              _pick_aligned_block if bwd_block_q else _pick_lane_block)(
+        s, bwd_block_q or _BWD_BLOCK_Q)
+    bwd_bk = (_pick_block if interpret else _pick_aligned_block)(
+        s, bwd_block_k or _BWD_BLOCK_K)
     # The kernels slice the (1, 1, S) LSE/delta rows at lane-dim offset
     # iq·block_q — compiled Mosaic wants those slices 128-aligned, so the
-    # Pallas path needs a lane-multiple q block (any S that is a multiple
-    # of 128 qualifies; everything else falls back to the XLA scan).
+    # Pallas path needs a 128-multiple q block.  _pick_lane_block prefers
+    # 128-multiple divisors of S, so the real condition is: S has a
+    # 128-multiple divisor ≤ the q-block budget (every multiple of 128
+    # qualifies; e.g. S=640 → block 128).  Anything else — e.g. S=200 —
+    # falls back to the XLA scan.
     ok = interpret or (bwd_bq % _LANES == 0)
     if backward == "auto":
         backward = "pallas" if ok else "xla"

@@ -30,7 +30,7 @@ def _mk(n, h, w_, ci, co, stride, dtype=jnp.float32, seed=0):
 
 SHAPES = [
     (2, 8, 8, 8, 16),
-    (4, 6, 6, 16, 8),   # n > bn exercises grid accumulation
+    (4, 6, 6, 16, 8),   # multi-image block (bn=n at the default budget)
     (1, 10, 8, 8, 8),   # non-square plane
     (2, 7, 5, 8, 8),    # odd plane dims: border masks on both axes
 ]
@@ -54,6 +54,25 @@ def test_dgrad_parity(n, h, w_, ci, co):
                                rtol=1e-4, atol=1e-4)
 
 
+def test_grid_accumulation_multi_batch_block(monkeypatch):
+    """ni>1 parity: at the default VMEM budget every SHAPES case fits one
+    batch block (bn=n), so the @pl.when(i==0) zeroing and cross-block dW
+    accumulation never run in interpret mode.  Shrinking the budget forces
+    bn<n (40 KB -> bn=2 for this shape) and exercises that path off-chip."""
+    from chainermn_tpu.ops import conv_backward as cb
+
+    monkeypatch.setattr(cb, "_VMEM_BUDGET", 40 * 1024)
+    n, h, w_, ci, co = 4, 6, 6, 16, 8
+    x, w, dy = _mk(n, h, w_, ci, co, 1, seed=5)
+    want_x, want_w = _oracle(x, w, dy, 1)
+    got_w = conv3x3_wgrad(x, dy, 1, interpret=True)
+    got_x = conv3x3_dgrad(dy, w, x.shape, 1, interpret=True)
+    np.testing.assert_allclose(np.asarray(got_w), np.asarray(want_w),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(got_x), np.asarray(want_x),
+                               rtol=1e-4, atol=1e-4)
+
+
 def test_same_pad_matches_xla():
     # The tap maps assume XLA's SAME split; check against lax's own output
     # shape arithmetic over the planes ResNet uses.
@@ -64,7 +83,10 @@ def test_same_pad_matches_xla():
 
 
 def test_conv2d_custom_vjp_end_to_end():
-    x, w, dy = _mk(2, 8, 8, 8, 8, 1, seed=3)
+    # 14x14 plane: h*w = 196 meets _eligible's floor, so the custom VJP
+    # actually dispatches to the Pallas dgrad/wgrad (an 8x8 plane would
+    # silently fall back to the XLA transpose rule and compare XLA to XLA).
+    x, w, dy = _mk(2, 14, 14, 8, 8, 1, seed=3)
 
     def loss_custom(x, w):
         return jnp.sum(conv2d(x, w, 1, True) * dy)

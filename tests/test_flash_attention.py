@@ -249,3 +249,32 @@ class TestBf16PartialPrecision:
             rel = np.max(np.abs(g - w)) / (np.max(np.abs(w)) + 1e-9)
             # bf16 grade: one bf16 rounding per partial (~2^-8 relative)
             assert rel < 2e-2, (name, rel)
+
+
+class TestLaneBlockPicker:
+    """Round-4 advisor finding: the backward q-block must be a 128-multiple
+    for compiled Mosaic's LSE row slices, and the plain 8-aligned pick
+    returned non-lane divisors (320 for S=640/1280), silently dropping
+    those shapes to the XLA scan."""
+
+    def test_prefers_lane_multiple_divisors(self):
+        from chainermn_tpu.ops.flash_attention import _pick_lane_block
+        assert _pick_lane_block(640, 512) == 128    # 320 is 8- not 128-aligned
+        assert _pick_lane_block(1280, 512) == 256
+        assert _pick_lane_block(8192, 512) == 512
+        assert _pick_lane_block(2048, 2048) == 2048
+        # no 128-multiple divisor ≤ budget → falls back to the 8-aligned
+        # pick (dispatch then routes to the XLA scan)
+        assert _pick_lane_block(200, 512) % 128 != 0
+
+    def test_s640_parity_on_pallas_route(self):
+        # S=640 now picks bwd_bq=128: verify backward parity at that block.
+        q, k, v = qkv(s=640)
+        def loss(f):
+            return lambda t: (f(t, k, v) ** 2).sum()
+        g_pallas = jax.grad(loss(lambda *a: flash_attention(
+            *a, causal=True, backward="pallas", bwd_block_q=128)))(q)
+        g_xla = jax.grad(loss(lambda *a: flash_attention(
+            *a, causal=True, backward="xla")))(q)
+        np.testing.assert_allclose(np.asarray(g_pallas), np.asarray(g_xla),
+                                   rtol=2e-4, atol=2e-4)
