@@ -1,0 +1,175 @@
+"""Flash-decode attention: one Pallas pass over the KV cache per tick.
+
+The decode tick's attention is bandwidth-bound — read every cached K and
+V byte once, at full HBM rate.  XLA's lowering of the per-head einsums
+(``bqhgd,bhkd->bhgqk`` with q-length 1) misses that floor ~2.4× in the
+compiled decode loop: with M=1 the dots lower to VPU multiply+reduce
+fusions over ``(S, head_dim=64)`` tiles whose minor dim fills only half
+of each 128-lane vreg (the round-4 HLO dump ranks these fusions top of
+the while body; the same chain STANDALONE compiles to MXU dots and hits
+1028 GB/s — the miss is a fusion/layout decision inside the big loop,
+not op cost).
+
+This kernel sidesteps the shape problem instead of fighting the fusion
+heuristics:
+
+* the cache is stored FLAT — ``(B, S, H·head_dim)`` — so every load
+  streams dense 128-lane rows (1024 lanes at the bench config);
+* per-head score reduction is a SEGMENTED MATMUL: ``scores (S_b, H) =
+  (K ⊙ q) @ SEG`` where ``SEG (H·hd, H)`` is the 0/1 head-membership
+  matrix — the MXU does the 64-wide segment sums, no reshapes, no
+  per-head GEMVs;
+* softmax is the standard online (m, l, acc) flash recursion over
+  S-blocks, entirely in VMEM/registers;
+* the probability-weighted V sum expands ``p (S_b, H)`` back to lanes
+  with ``SEGᵀ`` (MXU again) and reduces over the block's sublanes.
+
+Grid: ``(B, S/block_s)`` — per-batch-row state resets at the first
+S-block (the grid's minor dim iterates fastest).  The ``pos`` scalar
+arrives via scalar prefetch; positions beyond it are masked before the
+online max.  The kernel covers the h_q == h_kv case; GQA decode
+(h_kv < h_q needs per-q-head softmax over shared KV segments) stays on
+the einsum path in ``parallel/decode.py`` until a grouped variant lands.
+
+Reference relationship: no analog — the reference decoded by re-running
+the full decoder per token (SURVEY.md §2.9 seq2seq).  Parity oracle:
+the einsum attend in ``parallel/decode.py`` (``impl='xla'``), tested in
+tests/test_decode_attention.py.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["decode_attend"]
+
+_NEG = -1e30
+DEFAULT_BLOCK_S = 512  # single source for the kernel AND dispatch gates
+
+
+def _inherit_vma(*xs) -> frozenset:
+    vma = set()
+    for x in xs:
+        v = getattr(getattr(x, "aval", None), "vma", None)
+        if v:
+            vma |= set(v)
+    return frozenset(vma)
+
+
+def _pick_block_s(s: int, want: int = DEFAULT_BLOCK_S) -> int:
+    """Largest 8-aligned divisor of ``s`` ≤ ``want`` (0 = none)."""
+    if s <= want:
+        return s if s % 8 == 0 or s == 1 else 0
+    for b in range(want, 7, -1):
+        if s % b == 0 and b % 8 == 0:
+            return b
+    return 0
+
+
+def _kernel(pos_ref, q_ref, k_ref, v_ref, seg_ref, segt_ref, o_ref,
+            m_ref, l_ref, acc_ref, *, block_s, n_blocks, scale):
+    i, j = pl.program_id(0), pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, _NEG)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    k = k_ref[0]                                   # (S_b, D)
+    # q/o blocks stay whole-(B, D) resident (a (1, D) block would break
+    # the (8, 128) tiling rule, and Mosaic rejects unaligned dynamic
+    # sublane indexing) — the batch row is selected by iota mask
+    bidx = jax.lax.broadcasted_iota(jnp.int32, q_ref.shape, 0)
+    q = jnp.where(bidx == i, q_ref[...], 0).astype(jnp.float32).sum(
+        axis=0, keepdims=True)                     # (1, D)
+    seg = seg_ref[...]                             # (D, H) 0/1 f32
+    # segmented per-head dot: (K ⊙ q) @ SEG — MXU does the 64-wide sums
+    t = k.astype(jnp.float32) * q                  # (S_b, D)
+    s_blk = jax.lax.dot_general(
+        t, seg, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale  # (S_b, H)
+    idx = j * block_s + jax.lax.broadcasted_iota(
+        jnp.int32, s_blk.shape, 0)
+    s_blk = jnp.where(idx <= pos_ref[0], s_blk, _NEG)
+
+    m_prev = m_ref[...]                            # (1, H)
+    l_prev = l_ref[...]
+    m_new = jnp.maximum(m_prev, s_blk.max(axis=0, keepdims=True))
+    corr = jnp.exp(m_prev - m_new)                 # (1, H)
+    p = jnp.exp(s_blk - m_new)                     # (S_b, H)
+    m_ref[...] = m_new
+    l_ref[...] = l_prev * corr + p.sum(axis=0, keepdims=True)
+    segt = segt_ref[...]                           # (H, D)
+    p_lanes = jax.lax.dot_general(                 # (S_b, D)
+        p, segt, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    corr_lanes = jax.lax.dot_general(              # (1, D)
+        corr, segt, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    v = v_ref[0].astype(jnp.float32)               # (S_b, D)
+    acc_ref[...] = (acc_ref[...] * corr_lanes
+                    + (p_lanes * v).sum(axis=0, keepdims=True))
+
+    @pl.when(j == n_blocks - 1)
+    def _finish():
+        l_lanes = jax.lax.dot_general(
+            l_ref[...], segt, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        # write row i, preserve the others (the (B, D) block stays VMEM-
+        # resident across the whole grid; rows fill in as i advances)
+        val = (acc_ref[...] / l_lanes).astype(o_ref.dtype)
+        o_ref[...] = jnp.where(
+            jax.lax.broadcasted_iota(jnp.int32, o_ref.shape, 0) == i,
+            val, o_ref[...])
+
+
+@functools.partial(jax.jit, static_argnames=("n_heads", "head_dim",
+                                             "block_s", "interpret"))
+def decode_attend(q, kc, vc, pos, *, n_heads: int, head_dim: int,
+                  block_s: int = DEFAULT_BLOCK_S, interpret: bool = False):
+    """One decode tick's attention over the whole cache.
+
+    ``q (B, H·hd)`` flat queries, ``kc/vc (B, S, H·hd)`` flat caches
+    (positions > ``pos`` masked), returns ``ctx (B, H·hd)``.  Requires
+    the q-head count to equal the cache's ``n_heads`` (GQA decode stays
+    on the einsum path — see module docstring).
+    """
+    b, s, d = kc.shape
+    h = n_heads
+    assert d == h * head_dim, (d, h, head_dim)
+    bs = _pick_block_s(s, block_s)
+    if bs == 0:
+        raise ValueError(f"S={s} has no 8-aligned block ≤ {block_s}")
+    n_blocks = s // bs
+    scale = 1.0 / (head_dim ** 0.5)
+    seg = (jnp.arange(d)[:, None] // head_dim
+           == jnp.arange(h)[None, :]).astype(jnp.float32)
+    vma = _inherit_vma(q, kc, vc)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1, grid=(b, n_blocks),
+        in_specs=[
+            pl.BlockSpec((b, d), lambda i, j, p_: (0, 0)),
+            pl.BlockSpec((1, bs, d), lambda i, j, p_: (i, j, 0)),
+            pl.BlockSpec((1, bs, d), lambda i, j, p_: (i, j, 0)),
+            pl.BlockSpec((d, h), lambda i, j, p_: (0, 0)),
+            pl.BlockSpec((h, d), lambda i, j, p_: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((b, d), lambda i, j, p_: (0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((1, h), jnp.float32),
+            pltpu.VMEM((1, h), jnp.float32),
+            pltpu.VMEM((1, d), jnp.float32),
+        ])
+    return pl.pallas_call(
+        functools.partial(_kernel, block_s=bs, n_blocks=n_blocks,
+                          scale=scale),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, d), q.dtype, vma=vma),
+        interpret=interpret,
+    )(jnp.asarray([pos], jnp.int32), q, kc, vc, seg, seg.T)

@@ -81,31 +81,74 @@ def _decoder_core(params, head_dim: int, axis_name: str):
     def attn_block(x, blk, k_cache, v_cache, positions, write_at, q_valid):
         """x (N,S,D) → block output; caches written at ``write_at + i`` for
         the i-th input position; query i attends cache [:q_valid + i + 1).
+
+        Cache layout is FLAT — ``(B, total, H_kv·head_dim)`` — so every
+        cache load streams dense 128-lane rows; per-head structure is
+        recovered by view reshapes (einsum fallback) or the segmented
+        matmuls inside the flash-decode kernel.  The 4-D layouts measured
+        0.7-0.9 µs/position against a ~0.3 µs bandwidth floor in the
+        compiled decode loop because XLA lowered the q-length-1 dots to
+        VPU multiply+reduce fusions over half-empty 64-lane vregs
+        (scripts/profile_decode.py + the round-5 HLO dump).
         """
         n = x.shape[0]
 
         def attend(q, k, v):
-            kc = jax.lax.dynamic_update_slice_in_dim(k_cache, k, write_at, 1)
-            vc = jax.lax.dynamic_update_slice_in_dim(v_cache, v, write_at, 1)
-            # Per-query valid lengths make one formula serve prefill
-            # (causal) and decode (full prefix): query i sees
-            # q_valid + i + 1 entries.
+            from ..ops.kv_cache import cache_append
             s_q = q.shape[1]
+            hl, hkv = q.shape[2], k.shape[2]
+            # one-row decode appends go through the Pallas in-place
+            # scatter (ops/kv_cache.py): the XLA dus costs a full extra
+            # pass over the cache per tick; prefill's slab write (s_q >
+            # 1) falls back to dus inside cache_append
+            kc, vc = cache_append(
+                k_cache, v_cache, k.reshape(n, s_q, hkv * head_dim),
+                v.reshape(n, s_q, hkv * head_dim), write_at, axis=1)
+            if s_q > 1 and isinstance(write_at, int) and write_at == 0 \
+                    and isinstance(q_valid, int) and q_valid == 0:
+                # PREFILL: pure causal self-attention over the prompt —
+                # the flash kernels, not the naive einsum, which would
+                # materialize an (n, h, s_q, total) fp32 score tensor
+                # (268 MB/layer at the bench config; the HLO cost model
+                # ranked its softmax reductions above every decode op,
+                # and its cost GREW with the cache length, polluting the
+                # measured per-token decode rate).
+                from ..ops.flash_attention import flash_attention
+                ctx = flash_attention(q, k, v, causal=True)
+                return ctx.astype(x.dtype), (kc, vc)
+            from ..ops.decode_attention import _pick_block_s, decode_attend
+            if s_q == 1 and hl == hkv and jax.default_backend() == "tpu" \
+                    and _pick_block_s(kc.shape[1]) > 0:
+                # DECODE on TPU: one flash-decode Pallas pass — cache
+                # read once at full lane density (ops/decode_attention).
+                # Odd totals with no 8-aligned S-block (e.g. a max_new=1
+                # probe's 513) stay on the einsum fallback below.
+                ctx = decode_attend(
+                    q.reshape(n, hl * head_dim), kc, vc, write_at,
+                    n_heads=hkv, head_dim=head_dim)
+                return ctx.reshape(n, 1, hl, head_dim), (kc, vc)
+            # Fallback (GQA groups, non-TPU backends): grouped einsum
+            # attention against head-view reshapes of the flat cache.
+            # Per-query valid lengths make one formula serve chunked
+            # fills (causal) and decode (full prefix): query i sees
+            # q_valid + i + 1 entries.
+            total = kc.shape[1]
+            kc4 = kc.reshape(n, total, hkv, head_dim)
+            vc4 = vc.reshape(n, total, hkv, head_dim)
             valid = (q_valid + jnp.arange(s_q) + 1)[None, None, None, :, None]
-            hl, hkv = q.shape[2], kc.shape[2]
             # Grouped attention against the UN-expanded cache (GQA's
             # inference payoff): q heads regrouped onto their KV head — no
             # per-tick n_heads-sized cache copy.
             g = hl // hkv
             q5 = q.reshape(n, s_q, hkv, g, head_dim)
-            s = jnp.einsum("bqhgd,bkhd->bhgqk", q5, kc,
+            s = jnp.einsum("bqhgd,bkhd->bhgqk", q5, kc4,
                            preferred_element_type=jnp.float32) \
                 / (head_dim ** 0.5)
-            mask = (jnp.arange(kc.shape[1])[None, None, None, None, :]
+            mask = (jnp.arange(total)[None, None, None, None, :]
                     < valid)
             s = jnp.where(mask, s, -1e30)
             p = jax.nn.softmax(s, axis=-1)
-            ctx = jnp.einsum("bhgqk,bkhd->bqhgd", p.astype(vc.dtype), vc,
+            ctx = jnp.einsum("bhgqk,bkhd->bqhgd", p.astype(vc4.dtype), vc4,
                              preferred_element_type=jnp.float32
                              ).astype(x.dtype)
             return ctx, (kc, vc)
@@ -132,15 +175,16 @@ def _kv_heads(params, head_dim: int) -> int:
 def _prefill(params, embed, attn_block, prompt, total: int, head_dim: int):
     """Run the full prompt through the stack, returning ``(h_final,
     caches)`` with per-layer KV caches of length ``total`` (prompt written,
-    tail zeros)."""
+    tail zeros) in the flat ``(B, total, H_kv·head_dim)`` layout (see
+    ``attn_block``)."""
     b, s_p = prompt.shape
     n_kv = _kv_heads(params, head_dim)
     positions = jnp.arange(s_p)
     x = embed(prompt, positions)
     caches = []
     for blk in params["blocks"]:
-        k0 = jnp.zeros((b, total, n_kv, head_dim), x.dtype)
-        v0 = jnp.zeros((b, total, n_kv, head_dim), x.dtype)
+        k0 = jnp.zeros((b, total, n_kv * head_dim), x.dtype)
+        v0 = jnp.zeros((b, total, n_kv * head_dim), x.dtype)
         x, kc, vc = attn_block(x, blk, k0, v0, positions, 0, 0)
         caches.append((kc, vc))
     return _layer_norm(x, params["lnf_scale"], params["lnf_bias"]), caches
@@ -351,13 +395,13 @@ def lm_generate_beam(params, prompt, *, head_dim: int, axis_name: str,
         # tax the lazy path avoids).
         reind = []
         for kc, vc in new_caches:
-            shp = kc.shape  # (B·K, total, hkv, hd)
+            shp = kc.shape  # (B·K, total, hkv·hd) flat
             kc = jnp.take_along_axis(
                 kc.reshape((b, k) + shp[1:]),
-                parent[:, :, None, None, None], axis=1).reshape(shp)
+                parent[:, :, None, None], axis=1).reshape(shp)
             vc = jnp.take_along_axis(
                 vc.reshape((b, k) + shp[1:]),
-                parent[:, :, None, None, None], axis=1).reshape(shp)
+                parent[:, :, None, None], axis=1).reshape(shp)
             reind.append((kc, vc))
         return (tokens, scores, toks_buf, reind), None
 
@@ -417,8 +461,8 @@ def _beam_lazy(params, prompt, embed, attn_block, block_with, global_topk, *,
             return pcast(z, axis_name, to="varying")
         return jax.lax.pvary(z, axis_name)
 
-    gen = [(varying_zeros((b, k, max_new_tokens, n_kv, head_dim), pk.dtype),
-            varying_zeros((b, k, max_new_tokens, n_kv, head_dim), pv.dtype))
+    gen = [(varying_zeros((b, k, n_kv, max_new_tokens, head_dim), pk.dtype),
+            varying_zeros((b, k, n_kv, max_new_tokens, head_dim), pv.dtype))
            for pk, pv in pcaches]
     anc = jnp.zeros((b, k, max_new_tokens), jnp.int32)
     gen_pos = jnp.arange(max_new_tokens)
@@ -435,30 +479,42 @@ def _beam_lazy(params, prompt, embed, attn_block, block_with, global_topk, *,
 
         def attend(q, kk, vv):
             # append this tick's K/V into each slot's OWN row at pos i-1
-            gk2 = jax.lax.dynamic_update_slice_in_dim(
-                gk, kk.reshape(b, k, 1, n_kv, head_dim), i - 1, axis=2)
-            gv2 = jax.lax.dynamic_update_slice_in_dim(
-                gv, vv.reshape(b, k, 1, n_kv, head_dim), i - 1, axis=2)
+            # (Pallas in-place scatter on TPU — see ops/kv_cache.py).
+            # Layouts: the shared PROMPT cache is FLAT (b, s_p, hkv·hd)
+            # (position in dim 1, heads folded into the minor dim — the
+            # _prefill contract); the per-slot GENERATED caches are
+            # (b, slot, hkv, max_new, hd) with position SECOND-MINOR
+            # (axis=3), which is what cache_append's Pallas envelope
+            # needs for the one-row scatter.
+            from ..ops.kv_cache import cache_append
+            gk2, gv2 = cache_append(
+                gk, gv,
+                kk.reshape(b, k, 1, n_kv, head_dim).transpose(0, 1, 3, 2, 4),
+                vv.reshape(b, k, 1, n_kv, head_dim).transpose(0, 1, 3, 2, 4),
+                i - 1, axis=3)
             hl = q.shape[2]
             g = hl // n_kv
             q6 = q.reshape(b, k, n_kv, g, head_dim)
             scale = head_dim ** 0.5
             # prompt scores: shared cache, read ONCE for all K beams
-            sp = jnp.einsum("bshgd,bthd->bshgt", q6, pk,
+            # (flat (b, s_p, hkv·hd) prompt cache viewed per-head)
+            pk4 = pk.reshape(b, s_p, n_kv, head_dim)
+            pv4 = pv.reshape(b, s_p, n_kv, head_dim)
+            sp = jnp.einsum("bshgd,bthd->bshgt", q6, pk4,
                             preferred_element_type=jnp.float32) / scale
             # generated scores against ALL slots; the ancestry mask
             # selects the one true writer per position
-            sg = jnp.einsum("bshgd,blthd->bshglt", q6, gk2,
+            sg = jnp.einsum("bshgd,blhtd->bshglt", q6, gk2,
                             preferred_element_type=jnp.float32) / scale
             sg = jnp.where(amask[:, :, None, None, :, :], sg, -1e30)
             joint = jnp.concatenate(
-                [sp, sg.reshape(b, k, n_kv, g, k * gk2.shape[2])], axis=-1)
+                [sp, sg.reshape(b, k, n_kv, g, k * gk2.shape[3])], axis=-1)
             p = jax.nn.softmax(joint, axis=-1)
             p_p = p[..., :s_p].astype(pv.dtype)
             p_g = p[..., s_p:].reshape(sg.shape).astype(gv2.dtype)
-            ctx = (jnp.einsum("bshgt,bthd->bshgd", p_p, pv,
+            ctx = (jnp.einsum("bshgt,bthd->bshgd", p_p, pv4,
                               preferred_element_type=jnp.float32)
-                   + jnp.einsum("bshglt,blthd->bshgd", p_g, gv2,
+                   + jnp.einsum("bshglt,blhtd->bshgd", p_g, gv2,
                                 preferred_element_type=jnp.float32))
             return ctx.astype(x.dtype).reshape(b * k, 1, hl, head_dim), \
                 (gk2, gv2)

@@ -189,6 +189,7 @@ def make_flax_train_step(
     donate: bool = True,
     allreduce_grad_dtype=None,
     grad_reduce: Optional[Callable] = None,
+    preprocess: Optional[Callable] = None,
 ):
     """Train step for flax modules with mutable ``batch_stats`` (BatchNorm).
 
@@ -204,11 +205,22 @@ def make_flax_train_step(
     ``grad_reduce``: custom wire collective replacing the default pmean —
     e.g. ``ops.collective.hierarchical_pmean`` for the two-tier ICI×DCN
     mean over a multislice mesh (see :func:`_value_and_global_grads`).
+
+    ``preprocess(batch) -> batch`` runs INSIDE the jitted step, on the
+    local shard, before the model sees it — the TPU-first input contract:
+    upload the network's compact form (e.g. uint8 pixels, 4× fewer
+    host→device bytes than float32) and cast/normalize on device, where
+    XLA fuses it into the first conv's prologue.  The reference did the
+    equivalent transform on CPU inside its iterator workers
+    (SURVEY.md §2.9 ImageNet example); on TPU host-side float conversion
+    would quadruple PCIe/DCN ingest bytes for zero benefit.
     """
     if mesh is None:
         mesh = make_mesh(axis_name=axis_name)
 
     def spmd(variables, opt_state, batch):
+        if preprocess is not None:
+            batch = preprocess(batch)
         params = variables["params"]
         batch_stats = variables.get("batch_stats", {})
 

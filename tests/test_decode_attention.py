@@ -1,0 +1,69 @@
+"""Parity tests for the flash-decode attention kernel (interpret mode).
+
+Oracle: the einsum attend from parallel/decode.py's decode tick — same
+masking (positions ≤ pos), same fp32 softmax.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from chainermn_tpu.ops.decode_attention import decode_attend
+
+
+def oracle(q, kc, vc, pos, h, hd):
+    b, s, d = kc.shape
+    q4 = q.reshape(b, 1, h, hd)
+    k4 = kc.reshape(b, s, h, hd)
+    v4 = vc.reshape(b, s, h, hd)
+    sc = jnp.einsum("bqhd,bkhd->bhqk", q4, k4,
+                    preferred_element_type=jnp.float32) / (hd ** 0.5)
+    sc = jnp.where(jnp.arange(s)[None, None, None, :] <= pos, sc, -1e30)
+    p = jax.nn.softmax(sc, -1)
+    ctx = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v4.dtype), v4,
+                     preferred_element_type=jnp.float32)
+    return ctx.reshape(b, d)
+
+
+@pytest.mark.parametrize("b,s,h,hd,pos", [
+    (2, 64, 4, 16, 31),
+    (2, 64, 4, 16, 63),   # full cache valid
+    (1, 96, 2, 32, 0),    # single valid position
+    (3, 128, 8, 8, 100),  # pos mid-block
+])
+def test_matches_einsum_oracle(b, s, h, hd, pos):
+    rs = np.random.RandomState(0)
+    d = h * hd
+    q = jnp.asarray(rs.randn(b, d), jnp.float32)
+    kc = jnp.asarray(rs.randn(b, s, d), jnp.float32)
+    vc = jnp.asarray(rs.randn(b, s, d), jnp.float32)
+    got = decode_attend(q, kc, vc, pos, n_heads=h, head_dim=hd,
+                        block_s=32, interpret=True)
+    want = oracle(q, kc, vc, pos, h, hd)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_bf16_cache():
+    rs = np.random.RandomState(1)
+    b, s, h, hd = 2, 128, 4, 16
+    d = h * hd
+    q = jnp.asarray(rs.randn(b, d), jnp.bfloat16)
+    kc = jnp.asarray(rs.randn(b, s, d), jnp.bfloat16)
+    vc = jnp.asarray(rs.randn(b, s, d), jnp.bfloat16)
+    got = decode_attend(q, kc, vc, 77, n_heads=h, head_dim=hd,
+                        block_s=64, interpret=True)
+    want = oracle(q.astype(jnp.float32), kc.astype(jnp.float32),
+                  vc.astype(jnp.float32), 77, h, hd)
+    assert got.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(want), rtol=2e-2, atol=2e-2)
+
+
+def test_block_must_divide():
+    q = jnp.zeros((1, 32))
+    kc = jnp.zeros((1, 100, 32))
+    with pytest.raises(ValueError, match="8-aligned"):
+        decode_attend(q, kc, kc, 5, n_heads=2, head_dim=16, block_s=64,
+                      interpret=True)

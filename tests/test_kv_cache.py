@@ -1,0 +1,87 @@
+"""Parity tests for the Pallas in-place cache append (interpret mode).
+
+Oracle: ``dynamic_update_slice_in_dim`` — cache_append's XLA fallback IS
+that op, so the Pallas path must match it bit-for-bit.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from chainermn_tpu.ops.kv_cache import cache_append
+
+
+def _mk(shape, dtype, seed):
+    return jnp.asarray(np.random.RandomState(seed).randn(*shape), dtype)
+
+
+@pytest.mark.parametrize("pos", [0, 5, 7, 8, 123, 127])
+def test_second_minor_axis_4d(pos):
+    # greedy layout before flattening: (B, H, S, D), position axis 2
+    b, h, s, d = 2, 4, 128, 16
+    kc, vc = _mk((b, h, s, d), jnp.float32, 0), _mk((b, h, s, d),
+                                                    jnp.float32, 1)
+    kn, vn = _mk((b, h, 1, d), jnp.float32, 2), _mk((b, h, 1, d),
+                                                    jnp.float32, 3)
+    got_k, got_v = cache_append(kc, vc, kn, vn, pos, axis=2,
+                                impl="pallas", interpret=True)
+    want_k = jax.lax.dynamic_update_slice_in_dim(kc, kn, pos, 2)
+    want_v = jax.lax.dynamic_update_slice_in_dim(vc, vn, pos, 2)
+    np.testing.assert_array_equal(np.asarray(got_k), np.asarray(want_k))
+    np.testing.assert_array_equal(np.asarray(got_v), np.asarray(want_v))
+
+
+def test_flat_3d_layout_and_dtype():
+    # the flat greedy cache: (B, S, H*D), position axis 1 (second-minor)
+    b, s, d = 3, 64, 32
+    kc, vc = _mk((b, s, d), jnp.bfloat16, 4), _mk((b, s, d), jnp.bfloat16, 5)
+    kn, vn = _mk((b, 1, d), jnp.bfloat16, 6), _mk((b, 1, d), jnp.bfloat16, 7)
+    got_k, got_v = cache_append(kc, vc, kn, vn, 33, axis=1,
+                                impl="pallas", interpret=True)
+    want_k = jax.lax.dynamic_update_slice_in_dim(kc, kn, 33, 1)
+    np.testing.assert_array_equal(np.asarray(got_k), np.asarray(want_k))
+    assert got_k.dtype == jnp.bfloat16
+
+
+def test_beam_5d_layout():
+    # lazy-beam generated caches: (B, slot, H, max_new, D), axis 3
+    b, k, h, t, d = 2, 3, 2, 16, 8
+    kc, vc = _mk((b, k, h, t, d), jnp.float32, 8), _mk((b, k, h, t, d),
+                                                       jnp.float32, 9)
+    kn, vn = (_mk((b, k, h, 1, d), jnp.float32, 10),
+              _mk((b, k, h, 1, d), jnp.float32, 11))
+    got_k, _ = cache_append(kc, vc, kn, vn, 9, axis=3,
+                            impl="pallas", interpret=True)
+    want_k = jax.lax.dynamic_update_slice_in_dim(kc, kn, 9, 3)
+    np.testing.assert_array_equal(np.asarray(got_k), np.asarray(want_k))
+
+
+def test_traced_position():
+    b, s, d = 2, 32, 16
+    kc = _mk((b, s, d), jnp.float32, 12)
+    kn = _mk((b, 1, d), jnp.float32, 13)
+
+    @jax.jit
+    def go(pos):
+        return cache_append(kc, kc, kn, kn, pos, axis=1, impl="pallas",
+                            interpret=True)[0]
+
+    for pos in (0, 15, 31):
+        np.testing.assert_array_equal(
+            np.asarray(go(pos)),
+            np.asarray(jax.lax.dynamic_update_slice_in_dim(kc, kn, pos, 1)))
+
+
+def test_envelope_rejections_and_fallback():
+    kc = jnp.zeros((2, 30, 16))  # extent 30 not 8-divisible
+    kn = jnp.zeros((2, 1, 16))
+    with pytest.raises(ValueError, match="second-minor"):
+        cache_append(kc, kc, kn, kn, 3, axis=1, impl="pallas")
+    # auto on a non-TPU backend (or unfittable shape) = the dus fallback
+    got, _ = cache_append(kc, kc, kn + 1, kn + 1, 3, axis=1, impl="auto")
+    np.testing.assert_array_equal(
+        np.asarray(got),
+        np.asarray(jax.lax.dynamic_update_slice_in_dim(kc, kn + 1, 3, 1)))
+    with pytest.raises(ValueError, match="impl"):
+        cache_append(kc, kc, kn, kn, 3, impl="bogus")

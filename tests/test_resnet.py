@@ -286,3 +286,41 @@ def test_nf_resnet_agc_trains_and_clips():
         assert (step_norm <= bound).all(), (path, step_norm.max())
         checked += 1
     assert checked > 10
+
+
+def test_flax_train_step_onchip_preprocess_uint8():
+    """preprocess= runs inside the jitted step: a uint8 batch uploads in
+    its compact form and matches the float path's update exactly (cast/
+    normalize on device is bit-identical to doing it on the host)."""
+    comm = mn.create_communicator("xla")
+    mesh = comm.mesh
+    model = ResNet18(num_classes=4, stem_strides=1)
+    variables = dict(model.init(jax.random.PRNGKey(0),
+                                jnp.zeros((1, 16, 16, 3)), train=False))
+    opt = optax.sgd(0.1)
+
+    def lam(logits, batch):
+        return cross_entropy_loss(logits, batch[1]), {}
+
+    rng = np.random.RandomState(0)
+    xs8 = rng.randint(0, 256, (8, 16, 16, 3), dtype=np.uint8)
+    ys = rng.randint(0, 4, 8).astype(np.int32)
+    norm = lambda u: u.astype(jnp.float32) / 255.0 - 0.5  # noqa: E731
+
+    step_u8 = mn.make_flax_train_step(
+        model, lam, opt, mesh=mesh, donate=False,
+        preprocess=lambda b: (norm(b[0]), b[1]))
+    step_f = mn.make_flax_train_step(model, lam, opt, mesh=mesh,
+                                     donate=False)
+    v0 = mn.replicate(variables, mesh)
+    st0 = mn.replicate(opt.init(variables["params"]), mesh)
+
+    vu, _, lu, _ = step_u8(v0, st0, mn.shard_batch((xs8, ys), mesh))
+    vf, _, lf, _ = step_f(
+        v0, st0,
+        mn.shard_batch((np.asarray(norm(xs8)), ys), mesh))
+    np.testing.assert_allclose(float(lu), float(lf), rtol=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(vu["params"]),
+                    jax.tree_util.tree_leaves(vf["params"])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
