@@ -46,7 +46,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-__all__ = ["decode_attend"]
+__all__ = ["decode_attend", "beam_attend_parts", "merge_attend_parts"]
 
 _NEG = -1e30
 DEFAULT_BLOCK_S = 512  # single source for the kernel AND dispatch gates
@@ -59,6 +59,14 @@ def _inherit_vma(*xs) -> frozenset:
         if v:
             vma |= set(v)
     return frozenset(vma)
+
+
+def _seg(d: int, n_heads: int):
+    """The 0/1 head-membership matrix ``(D, H)``: SEG[j, h] = 1 iff lane
+    ``j`` belongs to head ``h`` — single source for the kernels and the
+    merge (its transpose)."""
+    return (jnp.arange(d)[:, None] // (d // n_heads)
+            == jnp.arange(n_heads)[None, :]).astype(jnp.float32)
 
 
 def _pick_block_s(s: int, want: int = DEFAULT_BLOCK_S) -> int:
@@ -148,8 +156,7 @@ def decode_attend(q, kc, vc, pos, *, n_heads: int, head_dim: int,
         raise ValueError(f"S={s} has no 8-aligned block ≤ {block_s}")
     n_blocks = s // bs
     scale = 1.0 / (head_dim ** 0.5)
-    seg = (jnp.arange(d)[:, None] // head_dim
-           == jnp.arange(h)[None, :]).astype(jnp.float32)
+    seg = _seg(d, h)
     vma = _inherit_vma(q, kc, vc)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1, grid=(b, n_blocks),
@@ -173,3 +180,147 @@ def decode_attend(q, kc, vc, pos, *, n_heads: int, head_dim: int,
         out_shape=jax.ShapeDtypeStruct((b, d), q.dtype, vma=vma),
         interpret=interpret,
     )(jnp.asarray([pos], jnp.int32), q, kc, vc, seg, seg.T)
+
+
+def _beam_kernel(pos_ref, q_ref, k_ref, v_ref, seg_ref, segt_ref, mask_ref,
+                 acc_o_ref, m_o_ref, l_o_ref, m_ref, l_ref, acc_ref, *,
+                 beams, n_blocks, scale, masked):
+    """Beam variant: q rows [i·beams, (i+1)·beams) share batch row i's
+    cache segment; per-row online-softmax state; outputs UNNORMALIZED
+    (acc, m, l) so two segments (prompt + generated) merge outside with
+    the standard flash combine.  ``masked`` selects the ancestry-mask
+    operand (generated segment) vs fully-valid (prompt segment)."""
+    i, j = pl.program_id(0), pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, _NEG)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    kb = k_ref[0].astype(jnp.float32)              # (S_b, D)
+    vb = v_ref[0].astype(jnp.float32)
+    seg, segt = seg_ref[...], segt_ref[...]
+    rows = jax.lax.broadcasted_iota(jnp.int32, q_ref.shape, 0)
+    for s in range(beams):
+        q = jnp.where(rows == i * beams + s, q_ref[...], 0).astype(
+            jnp.float32).sum(axis=0, keepdims=True)           # (1, D)
+        s_blk = jax.lax.dot_general(
+            kb * q, seg, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale       # (S_b, H)
+        if masked:
+            # mask operand is f32: Mosaic only supports non-no-op minor-
+            # dim insertion ([:, None]) on 32-bit types
+            mrow = mask_ref[0, s, :][:, None]                 # (S_b, 1)
+            s_blk = jnp.where(mrow > 0.5, s_blk, _NEG)
+        m_prev = m_ref[s:s + 1, :]                            # (1, H)
+        l_prev = l_ref[s:s + 1, :]
+        m_new = jnp.maximum(m_prev, s_blk.max(axis=0, keepdims=True))
+        corr = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s_blk - m_new)
+        m_ref[s:s + 1, :] = m_new
+        l_ref[s:s + 1, :] = l_prev * corr + p.sum(axis=0, keepdims=True)
+        p_lanes = jax.lax.dot_general(
+            p, segt, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        corr_lanes = jax.lax.dot_general(
+            corr, segt, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        acc_ref[s:s + 1, :] = (acc_ref[s:s + 1, :] * corr_lanes
+                               + (p_lanes * vb).sum(axis=0, keepdims=True))
+
+    @pl.when(j == n_blocks - 1)
+    def _finish():
+        orows = jax.lax.broadcasted_iota(jnp.int32, acc_o_ref.shape, 0)
+        hrows = jax.lax.broadcasted_iota(jnp.int32, m_o_ref.shape, 0)
+        for s in range(beams):
+            r = i * beams + s
+            acc_o_ref[...] = jnp.where(orows == r, acc_ref[s:s + 1, :],
+                                       acc_o_ref[...])
+            m_o_ref[...] = jnp.where(hrows == r, m_ref[s:s + 1, :],
+                                     m_o_ref[...])
+            l_o_ref[...] = jnp.where(hrows == r, l_ref[s:s + 1, :],
+                                     l_o_ref[...])
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "beams", "n_heads", "head_dim", "block_s", "interpret"))
+def beam_attend_parts(q, kc, vc, amask=None, *, beams: int, n_heads: int,
+                      head_dim: int, block_s: int = DEFAULT_BLOCK_S,
+                      interpret: bool = False):
+    """One cache SEGMENT's worth of beam attention, unnormalized.
+
+    ``q (B·beams, H·hd)`` flat per-beam queries; ``kc/vc (B, S_seg,
+    H·hd)`` a cache segment shared by each batch row's ``beams`` rows —
+    the shared PROMPT cache (pass ``amask=None``: every position valid)
+    or the flat per-slot GENERATED caches ``(B, slots·T, D)`` with
+    ``amask (B, beams, S_seg)`` (any 0/1 dtype; carried as f32 in the
+    kernel) = ancestry ∧ validity.  Returns
+    ``(acc (B·beams, D) f32 unnormalized, m (B·beams, H) f32,
+    l (B·beams, H) f32)``; merge segments with the flash combine
+    (see ``merge_attend_parts``).
+    """
+    bk, d = q.shape
+    b, s, _ = kc.shape
+    assert bk == b * beams, (bk, b, beams)
+    h = n_heads
+    assert d == h * head_dim, (d, h, head_dim)
+    bs = _pick_block_s(s, block_s)
+    if bs == 0:
+        raise ValueError(f"S={s} has no 8-aligned block ≤ {block_s}")
+    n_blocks = s // bs
+    scale = 1.0 / (head_dim ** 0.5)
+    seg = _seg(d, h)
+    masked = amask is not None
+    if not masked:  # constant dummy keeps ONE kernel signature
+        amask = jnp.ones((b, beams, s), jnp.float32)
+    vma = _inherit_vma(q, kc, vc)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1, grid=(b, n_blocks),
+        in_specs=[
+            pl.BlockSpec((bk, d), lambda i, j, p_: (0, 0)),
+            pl.BlockSpec((1, bs, d), lambda i, j, p_: (i, j, 0)),
+            pl.BlockSpec((1, bs, d), lambda i, j, p_: (i, j, 0)),
+            pl.BlockSpec((d, h), lambda i, j, p_: (0, 0)),
+            pl.BlockSpec((h, d), lambda i, j, p_: (0, 0)),
+            pl.BlockSpec((1, beams, bs), lambda i, j, p_: (i, 0, j)),
+        ],
+        out_specs=[
+            pl.BlockSpec((bk, d), lambda i, j, p_: (0, 0)),
+            pl.BlockSpec((bk, h), lambda i, j, p_: (0, 0)),
+            pl.BlockSpec((bk, h), lambda i, j, p_: (0, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((beams, h), jnp.float32),
+            pltpu.VMEM((beams, h), jnp.float32),
+            pltpu.VMEM((beams, d), jnp.float32),
+        ])
+    return pl.pallas_call(
+        functools.partial(_beam_kernel, beams=beams,
+                          n_blocks=n_blocks, scale=scale, masked=masked),
+        grid_spec=grid_spec,
+        out_shape=[jax.ShapeDtypeStruct((bk, d), jnp.float32, vma=vma),
+                   jax.ShapeDtypeStruct((bk, h), jnp.float32, vma=vma),
+                   jax.ShapeDtypeStruct((bk, h), jnp.float32, vma=vma)],
+        interpret=interpret,
+    )(jnp.zeros((1,), jnp.int32), q, kc, vc, seg, seg.T,
+      amask.astype(jnp.float32))
+
+
+def merge_attend_parts(parts, n_heads: int, head_dim: int, dtype):
+    """Flash combine of ≥2 ``(acc, m, l)`` segments → normalized context
+    ``(B·beams, H·hd)`` in ``dtype``."""
+    d = n_heads * head_dim
+    seg_t = _seg(d, n_heads).T
+
+    def lanes(x):  # (N, H) -> (N, D) per-head broadcast
+        return x @ seg_t
+
+    m = functools.reduce(jnp.maximum, [p[1] for p in parts])
+    l_tot = 0.0
+    acc_tot = 0.0
+    for acc, m_i, l_i in parts:
+        a = jnp.exp(m_i - m)
+        l_tot = l_tot + l_i * a
+        acc_tot = acc_tot + acc * lanes(a)
+    return (acc_tot / lanes(l_tot)).astype(dtype)

@@ -295,7 +295,7 @@ def lm_generate(params, prompt, rng: Optional[jax.Array] = None, *,
 
 def lm_generate_beam(params, prompt, *, head_dim: int, axis_name: str,
                      max_new_tokens: int, beam_size: int,
-                     lazy_reorder: bool = True):
+                     lazy_reorder: bool = True, attend_impl: str = "auto"):
     """Beam search with the KV cache: the highest-cumulative-log-prob
     continuation of each prompt among ``beam_size`` beams.
 
@@ -364,11 +364,15 @@ def lm_generate_beam(params, prompt, *, head_dim: int, axis_name: str,
         ids = jnp.take_along_axis(gi, pos, axis=1)
         return v, ids
 
+    if attend_impl not in ("auto", "kernel", "einsum"):
+        raise ValueError(f"attend_impl must be auto|kernel|einsum, "
+                         f"got {attend_impl!r}")
     if lazy_reorder:
         return _beam_lazy(params, prompt, embed, attn_block, block_with,
                           global_topk, head_dim=head_dim,
                           axis_name=axis_name,
-                          max_new_tokens=max_new_tokens, beam_size=k)
+                          max_new_tokens=max_new_tokens, beam_size=k,
+                          attend_impl=attend_impl)
 
     # ---- prefill once at batch B, then tile caches to B·K ----
     h, caches = _prefill(params, embed, attn_block, prompt, total, head_dim)
@@ -434,7 +438,7 @@ def _merge_candidates(global_topk, h, scores, toks_buf, i, b, k):
 
 def _beam_lazy(params, prompt, embed, attn_block, block_with, global_topk, *,
                head_dim: int, axis_name: str, max_new_tokens: int,
-               beam_size: int):
+               beam_size: int, attend_impl: str = "auto"):
     """Ancestry-indexed beam decode body (see ``lm_generate_beam``
     docstring): shared prompt cache + per-slot append-only generated
     caches + a reordered index table instead of reordered caches."""
@@ -461,8 +465,8 @@ def _beam_lazy(params, prompt, embed, attn_block, block_with, global_topk, *,
             return pcast(z, axis_name, to="varying")
         return jax.lax.pvary(z, axis_name)
 
-    gen = [(varying_zeros((b, k, n_kv, max_new_tokens, head_dim), pk.dtype),
-            varying_zeros((b, k, n_kv, max_new_tokens, head_dim), pv.dtype))
+    gen = [(varying_zeros((b, k, max_new_tokens, n_kv * head_dim), pk.dtype),
+            varying_zeros((b, k, max_new_tokens, n_kv * head_dim), pv.dtype))
            for pk, pv in pcaches]
     anc = jnp.zeros((b, k, max_new_tokens), jnp.int32)
     gen_pos = jnp.arange(max_new_tokens)
@@ -481,40 +485,74 @@ def _beam_lazy(params, prompt, embed, attn_block, block_with, global_topk, *,
             # append this tick's K/V into each slot's OWN row at pos i-1
             # (Pallas in-place scatter on TPU — see ops/kv_cache.py).
             # Layouts: the shared PROMPT cache is FLAT (b, s_p, hkv·hd)
-            # (position in dim 1, heads folded into the minor dim — the
-            # _prefill contract); the per-slot GENERATED caches are
-            # (b, slot, hkv, max_new, hd) with position SECOND-MINOR
-            # (axis=3), which is what cache_append's Pallas envelope
-            # needs for the one-row scatter.
+            # (position in dim 1, heads in the minor dim — the _prefill
+            # contract); the per-slot GENERATED caches are
+            # (b, slot, max_new, hkv·hd) — position SECOND-MINOR (axis=2,
+            # the cache_append Pallas envelope) and flattenable to the
+            # (b, slot·max_new, hkv·hd) segment the beam kernel reads.
+            from ..ops.decode_attention import (_pick_block_s,
+                                                beam_attend_parts,
+                                                merge_attend_parts)
             from ..ops.kv_cache import cache_append
             gk2, gv2 = cache_append(
-                gk, gv,
-                kk.reshape(b, k, 1, n_kv, head_dim).transpose(0, 1, 3, 2, 4),
-                vv.reshape(b, k, 1, n_kv, head_dim).transpose(0, 1, 3, 2, 4),
-                i - 1, axis=3)
+                gk, gv, kk.reshape(b, k, 1, n_kv * head_dim),
+                vv.reshape(b, k, 1, n_kv * head_dim), i - 1, axis=2)
             hl = q.shape[2]
             g = hl // n_kv
-            q6 = q.reshape(b, k, n_kv, g, head_dim)
+            t_max = gk2.shape[2]
             scale = head_dim ** 0.5
+            kernel_ok = (g == 1 and _pick_block_s(s_p) > 0
+                         and _pick_block_s(k * t_max) > 0)
+            # ``attend_impl='einsum'`` forces the fallback (the on-chip
+            # parity oracle for the kernel path); 'kernel' forces the
+            # Pallas path (interpret off-TPU — note interpret-Pallas
+            # under shard_map trips VMA checks, so off-chip coverage of
+            # the flatten/mask convention lives in tests/test_decode.py
+            # :: test_beam_kernel_slot_flattening_convention instead).
+            if kernel_ok and (attend_impl == "kernel"
+                              or (attend_impl == "auto"
+                                  and jax.default_backend() == "tpu")):
+                # flash-decode beam path: one Pallas pass per segment
+                # (shared prompt, ancestry-masked slots), merged with the
+                # standard (m, l, acc) flash combine — the einsum path
+                # below pays the same VPU half-lane tax greedy decode did.
+                interp = jax.default_backend() != "tpu"
+                qf = q.reshape(b * k, hl * head_dim)
+                part_p = beam_attend_parts(
+                    qf, pk, pv, beams=k, n_heads=n_kv, head_dim=head_dim,
+                    interpret=interp)
+                part_g = beam_attend_parts(
+                    qf, gk2.reshape(b, k * t_max, n_kv * head_dim),
+                    gv2.reshape(b, k * t_max, n_kv * head_dim),
+                    amask.reshape(b, k, k * t_max).astype(jnp.int8),
+                    beams=k, n_heads=n_kv, head_dim=head_dim,
+                    interpret=interp)
+                ctx = merge_attend_parts(
+                    [part_p, part_g], n_heads=n_kv, head_dim=head_dim,
+                    dtype=x.dtype)
+                return ctx.reshape(b * k, 1, hl, head_dim), (gk2, gv2)
+            q6 = q.reshape(b, k, n_kv, g, head_dim)
             # prompt scores: shared cache, read ONCE for all K beams
-            # (flat (b, s_p, hkv·hd) prompt cache viewed per-head)
+            # (flat caches viewed per-head for the einsum fallback)
             pk4 = pk.reshape(b, s_p, n_kv, head_dim)
             pv4 = pv.reshape(b, s_p, n_kv, head_dim)
+            gk5 = gk2.reshape(b, k, t_max, n_kv, head_dim)
+            gv5 = gv2.reshape(b, k, t_max, n_kv, head_dim)
             sp = jnp.einsum("bshgd,bthd->bshgt", q6, pk4,
                             preferred_element_type=jnp.float32) / scale
             # generated scores against ALL slots; the ancestry mask
             # selects the one true writer per position
-            sg = jnp.einsum("bshgd,blhtd->bshglt", q6, gk2,
+            sg = jnp.einsum("bshgd,blthd->bshglt", q6, gk5,
                             preferred_element_type=jnp.float32) / scale
             sg = jnp.where(amask[:, :, None, None, :, :], sg, -1e30)
             joint = jnp.concatenate(
-                [sp, sg.reshape(b, k, n_kv, g, k * gk2.shape[3])], axis=-1)
+                [sp, sg.reshape(b, k, n_kv, g, k * t_max)], axis=-1)
             p = jax.nn.softmax(joint, axis=-1)
             p_p = p[..., :s_p].astype(pv.dtype)
             p_g = p[..., s_p:].reshape(sg.shape).astype(gv2.dtype)
             ctx = (jnp.einsum("bshgt,bthd->bshgd", p_p, pv4,
                               preferred_element_type=jnp.float32)
-                   + jnp.einsum("bshglt,blhtd->bshgd", p_g, gv2,
+                   + jnp.einsum("bshglt,blthd->bshgd", p_g, gv5,
                                 preferred_element_type=jnp.float32))
             return ctx.astype(x.dtype).reshape(b * k, 1, hl, head_dim), \
                 (gk2, gv2)
@@ -554,14 +592,15 @@ def _beam_lazy(params, prompt, embed, attn_block, block_with, global_topk, *,
 def make_lm_beam_generator(mesh: Optional[Mesh] = None,
                            axis_name: str = "model", *, head_dim: int,
                            max_new_tokens: int, beam_size: int,
-                           lazy_reorder: bool = True):
+                           lazy_reorder: bool = True,
+                           attend_impl: str = "auto"):
     """Eager/jit face of :func:`lm_generate_beam`: ``fn(params, prompt) ->
     (B, max_new) tokens`` over TP-sharded global params."""
     return _make_face(
         mesh, axis_name,
         partial(lm_generate_beam, head_dim=head_dim, axis_name=axis_name,
                 max_new_tokens=max_new_tokens, beam_size=beam_size,
-                lazy_reorder=lazy_reorder),
+                lazy_reorder=lazy_reorder, attend_impl=attend_impl),
         has_rng=False)
 
 
