@@ -254,7 +254,12 @@ def bench_transformer_lm(n_chips_hint=None, seq=1024, per_chip_batch=8,
     # 40 steps per host readback: the axon tunnel's readback costs ~100ms
     # flat (measured), so few-step loops inflate per-step time by ~10ms.
     steps = 40
-    dt, _ = measure(step_c, p, st, batch, steps=steps)
+    # median-of-3 epochs: a single axon-tunnel stall during one epoch
+    # poisoned a max-of-2 row 28x in a round-5 artifact (lm_S4096 at
+    # 3.3k tok/s with suspect:false); the median survives one stalled
+    # AND one anomalously fast epoch.
+    dt, _ = measure(step_c, p, st, batch, steps=steps, epochs=3,
+                    reduce="median")
     toks = per_chip_batch * seq  # per chip per step
     tps = steps * toks / dt  # measure() already covers all chips' shards: dt
     # is wall-clock for the whole mesh, so per-chip tokens/sec uses per-chip
@@ -317,26 +322,42 @@ def bench_long_context():
     from chainermn_tpu.ops.flash_attention import flash_attention
 
     def flash_row(S, B, reps, H, HD):
+        """Per-rep time by the SLOPE between two chain lengths (reps and
+        3·reps): immune to the tunnel's ~104 ms fixed readback cost.  The
+        round-5 hd128 kernels got fast enough that subtracting an assumed
+        0.1 s from a single short chain inflated one artifact row to an
+        impossible-looking 0.849 attn-MFU; (t2-t1)/(r2-r1) needs no RTT
+        estimate at all (validated against interleaved same-process runs,
+        docs/PERF.md round 5)."""
         q = jax.device_put(rs.randn(B, S, H, HD).astype(jnp.bfloat16))
         flops = 2 * 2 * B * H * S * S * HD / 2 * 3.5  # causal fwd+bwd
 
-        @jax.jit
-        def chain(qq):
-            def body(c, _):
-                o, vjp = jax.vjp(
-                    lambda a: flash_attention(a, a, a, causal=True), c)
-                (dq,) = vjp(o)
-                return dq.astype(c.dtype), None
-            fin, _ = jax.lax.scan(body, qq, None, length=reps)
-            return jnp.max(fin).astype(jnp.float32)
+        def chain_n(n):
+            @jax.jit
+            def chain(qq):
+                def body(c, _):
+                    o, vjp = jax.vjp(
+                        lambda a: flash_attention(a, a, a, causal=True), c)
+                    (dq,) = vjp(o)
+                    return dq.astype(c.dtype), None
+                fin, _ = jax.lax.scan(body, qq, None, length=n)
+                return jnp.max(fin).astype(jnp.float32)
+            return chain
 
-        float(chain(q))
-        best = float("inf")
+        # The two programs differ ONLY in scan trip count — the while
+        # body compiles once per program with the same schedule, so the
+        # slope cancels the fixed cost without assuming its size (the
+        # c6678d7 schedule variance was CROSS-process; raw chain times
+        # are recorded in the row for auditability).
+        c1, c2 = chain_n(reps), chain_n(3 * reps)
+        float(c1(q)); float(c2(q))
+        t1s, t2s = [], []
         for _ in range(2):
-            t0 = time.perf_counter()
-            float(chain(q))
-            best = min(best, (time.perf_counter() - t0 - 0.1) / reps)
-        best = max(best, 1e-4)  # RTT subtraction must not negate a fast run
+            t0 = time.perf_counter(); float(c1(q))
+            t1s.append(time.perf_counter() - t0)
+            t0 = time.perf_counter(); float(c2(q))
+            t2s.append(time.perf_counter() - t0)
+        best = max((min(t2s) - min(t1s)) / (2 * reps), 1e-4)
         mfu = flops / best / peak if peak else None
         if mfu and mfu > 1.0:
             print(f"bench: WARNING long-context S={S} attention MFU "
@@ -346,6 +367,8 @@ def bench_long_context():
             "ms": round(best * 1e3, 2),
             "attn_mfu": round(mfu, 3) if mfu else None,
             "heads": f"{H}x{HD}",
+            "chains_s": [round(min(t1s), 3), round(min(t2s), 3)],
+            "reps": [reps, 3 * reps],
             "suspect": bool(mfu and mfu > 1.0),
         }
 
@@ -418,14 +441,23 @@ def bench_data_path(demand_ips=None):
         disk = mn.FileDataset(os.path.join(tmp, "ds", "train"))
 
         def assembly_ips(copy):
-            it = mn.PrefetchIterator(disk, batch_size=b, seed=1, copy=copy)
+            # With the default 16-slot ring the C++ workers pre-assemble
+            # the WHOLE 11-batch run during warmup and the loop times
+            # pointer acquisition (a round-5 artifact read 5M img/s).
+            # Fix: a 4-slot ring, and the rate counts only the
+            # ``steps - n_slots`` batches the workers must ASSEMBLE
+            # during the drain (the first n_slots acquisitions consume
+            # pre-built slots) — a conservative true-assembly rate.
+            n_slots = 4
+            it = mn.PrefetchIterator(disk, batch_size=b, seed=1, copy=copy,
+                                     n_slots=n_slots)
             next(it)  # spin up the ring
             t0 = time.perf_counter()
             for _ in range(steps):
                 next(it)
             dt = time.perf_counter() - t0
             it.close()
-            return steps * b / dt
+            return (steps - n_slots) * b / dt
 
         nocopy = assembly_ips(copy=False)
         out["assembly_ips_nocopy"] = round(nocopy, 1)
@@ -608,7 +640,7 @@ def scaling_worker(n, grad_dtype=None, double_buffering=False):
     print(json.dumps(out))
 
 
-def run_scaling_sweep(ns=(1, 4, 8), over_budget=None, budget_left=None):
+def run_scaling_sweep(ns=(1, 8, 4), over_budget=None, budget_left=None):
     """Weak-scaling sweep in fresh CPU subprocesses (platform is per-process).
 
     Reports per-point efficiency vs n=1 and the measured gradient-pmean
@@ -1139,7 +1171,7 @@ def main():
 
     # --- DP weak-scaling sweep (virtual CPU mesh, fresh subprocesses) ------
     if not args.skip_scaling and not over_budget():
-        ns = (1, 2, 4, 8, 16, 32) if args.full_sweep else (1, 4, 8)
+        ns = (1, 2, 4, 8, 16, 32) if args.full_sweep else (1, 8, 4)
         budget_left = lambda: budget_s - (time.time() - t_start)  # noqa: E731
         result["scaling"] = run_scaling_sweep(
             ns, over_budget=over_budget, budget_left=budget_left)
