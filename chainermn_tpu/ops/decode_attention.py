@@ -27,9 +27,10 @@ heuristics:
 Grid: ``(B, S/block_s)`` — per-batch-row state resets at the first
 S-block (the grid's minor dim iterates fastest).  The ``pos`` scalar
 arrives via scalar prefetch; positions beyond it are masked before the
-online max.  The kernel covers the h_q == h_kv case; GQA decode
-(h_kv < h_q needs per-q-head softmax over shared KV segments) stays on
-the einsum path in ``parallel/decode.py`` until a grouped variant lands.
+online max.  ``decode_attend`` covers h_q == h_kv; GQA decode rides the
+BEAM kernel (``decode_attend_gqa``: the g query groups of a batch row
+share its cache row — exactly the beam row mapping — with the position
+mask in the mask operand).
 
 Reference relationship: no analog — the reference decoded by re-running
 the full decoder per token (SURVEY.md §2.9 seq2seq).  Parity oracle:
@@ -46,7 +47,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-__all__ = ["decode_attend", "beam_attend_parts", "merge_attend_parts"]
+__all__ = ["decode_attend", "decode_attend_gqa",
+           "beam_attend_parts", "merge_attend_parts"]
 
 _NEG = -1e30
 DEFAULT_BLOCK_S = 512  # single source for the kernel AND dispatch gates
@@ -145,8 +147,8 @@ def decode_attend(q, kc, vc, pos, *, n_heads: int, head_dim: int,
 
     ``q (B, H·hd)`` flat queries, ``kc/vc (B, S, H·hd)`` flat caches
     (positions > ``pos`` masked), returns ``ctx (B, H·hd)``.  Requires
-    the q-head count to equal the cache's ``n_heads`` (GQA decode stays
-    on the einsum path — see module docstring).
+    the q-head count to equal the cache's ``n_heads``; GQA decode goes
+    through :func:`decode_attend_gqa` (the beam kernel).
     """
     b, s, d = kc.shape
     h = n_heads
@@ -184,7 +186,7 @@ def decode_attend(q, kc, vc, pos, *, n_heads: int, head_dim: int,
 
 def _beam_kernel(pos_ref, q_ref, k_ref, v_ref, seg_ref, segt_ref, mask_ref,
                  acc_o_ref, m_o_ref, l_o_ref, m_ref, l_ref, acc_ref, *,
-                 beams, n_blocks, scale, masked):
+                 beams, block_s, n_blocks, scale, masked):
     """Beam variant: q rows [i·beams, (i+1)·beams) share batch row i's
     cache segment; per-row online-softmax state; outputs UNNORMALIZED
     (acc, m, l) so two segments (prompt + generated) merge outside with
@@ -208,11 +210,18 @@ def _beam_kernel(pos_ref, q_ref, k_ref, v_ref, seg_ref, segt_ref, mask_ref,
         s_blk = jax.lax.dot_general(
             kb * q, seg, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32) * scale       # (S_b, H)
-        if masked:
+        if masked == "amask":
             # mask operand is f32: Mosaic only supports non-no-op minor-
             # dim insertion ([:, None]) on 32-bit types
             mrow = mask_ref[0, s, :][:, None]                 # (S_b, 1)
             s_blk = jnp.where(mrow > 0.5, s_blk, _NEG)
+        elif masked == "pos":
+            # position-validity from the prefetch scalar — zero HBM cost
+            # (the GQA path's mask; an f32 operand here would stream
+            # B·g·S·4 bytes per layer per tick)
+            idx = j * block_s + jax.lax.broadcasted_iota(
+                jnp.int32, s_blk.shape, 0)
+            s_blk = jnp.where(idx <= pos_ref[0], s_blk, _NEG)
         m_prev = m_ref[s:s + 1, :]                            # (1, H)
         l_prev = l_ref[s:s + 1, :]
         m_new = jnp.maximum(m_prev, s_blk.max(axis=0, keepdims=True))
@@ -245,8 +254,9 @@ def _beam_kernel(pos_ref, q_ref, k_ref, v_ref, seg_ref, segt_ref, mask_ref,
 
 @functools.partial(jax.jit, static_argnames=(
     "beams", "n_heads", "head_dim", "block_s", "interpret"))
-def beam_attend_parts(q, kc, vc, amask=None, *, beams: int, n_heads: int,
-                      head_dim: int, block_s: int = DEFAULT_BLOCK_S,
+def beam_attend_parts(q, kc, vc, amask=None, pos=None, *, beams: int,
+                      n_heads: int, head_dim: int,
+                      block_s: int = DEFAULT_BLOCK_S,
                       interpret: bool = False):
     """One cache SEGMENT's worth of beam attention, unnormalized.
 
@@ -271,9 +281,16 @@ def beam_attend_parts(q, kc, vc, amask=None, *, beams: int, n_heads: int,
     n_blocks = s // bs
     scale = 1.0 / (head_dim ** 0.5)
     seg = _seg(d, h)
-    masked = amask is not None
-    if not masked:  # constant dummy keeps ONE kernel signature
-        amask = jnp.ones((b, beams, s), jnp.float32)
+    masked = "amask" if amask is not None else (
+        "pos" if pos is not None else "none")
+    if amask is None:
+        # tiny constant dummy keeps ONE kernel signature at ~zero DMA
+        # (the pos/none modes never read it; an (b, beams, s) dummy
+        # would stream B·beams·S·4 bytes per tick for nothing)
+        amask = jnp.ones((1, beams, 8), jnp.float32)
+        mask_spec = pl.BlockSpec((1, beams, 8), lambda i, j, p_: (0, 0, 0))
+    else:
+        mask_spec = pl.BlockSpec((1, beams, bs), lambda i, j, p_: (i, 0, j))
     vma = _inherit_vma(q, kc, vc)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1, grid=(b, n_blocks),
@@ -283,7 +300,7 @@ def beam_attend_parts(q, kc, vc, amask=None, *, beams: int, n_heads: int,
             pl.BlockSpec((1, bs, d), lambda i, j, p_: (i, j, 0)),
             pl.BlockSpec((d, h), lambda i, j, p_: (0, 0)),
             pl.BlockSpec((h, d), lambda i, j, p_: (0, 0)),
-            pl.BlockSpec((1, beams, bs), lambda i, j, p_: (i, 0, j)),
+            mask_spec,
         ],
         out_specs=[
             pl.BlockSpec((bk, d), lambda i, j, p_: (0, 0)),
@@ -296,15 +313,15 @@ def beam_attend_parts(q, kc, vc, amask=None, *, beams: int, n_heads: int,
             pltpu.VMEM((beams, d), jnp.float32),
         ])
     return pl.pallas_call(
-        functools.partial(_beam_kernel, beams=beams,
+        functools.partial(_beam_kernel, beams=beams, block_s=bs,
                           n_blocks=n_blocks, scale=scale, masked=masked),
         grid_spec=grid_spec,
         out_shape=[jax.ShapeDtypeStruct((bk, d), jnp.float32, vma=vma),
                    jax.ShapeDtypeStruct((bk, h), jnp.float32, vma=vma),
                    jax.ShapeDtypeStruct((bk, h), jnp.float32, vma=vma)],
         interpret=interpret,
-    )(jnp.zeros((1,), jnp.int32), q, kc, vc, seg, seg.T,
-      amask.astype(jnp.float32))
+    )(jnp.asarray([0 if pos is None else pos], jnp.int32), q, kc, vc,
+      seg, seg.T, amask.astype(jnp.float32))
 
 
 def merge_attend_parts(parts, n_heads: int, head_dim: int, dtype):
@@ -324,3 +341,39 @@ def merge_attend_parts(parts, n_heads: int, head_dim: int, dtype):
         l_tot = l_tot + l_i * a
         acc_tot = acc_tot + acc * lanes(a)
     return (acc_tot / lanes(l_tot)).astype(dtype)
+
+
+def decode_attend_gqa(q, kc, vc, pos, *, n_q_heads: int, n_kv_heads: int,
+                      head_dim: int, block_s: int = DEFAULT_BLOCK_S,
+                      interpret: bool = False):
+    """GQA decode tick: grouped queries against the shared-KV-head cache.
+
+    Structurally the BEAM problem: the ``g = n_q_heads/n_kv_heads`` query
+    groups of batch row ``b`` all attend batch row ``b``'s cache — so the
+    beam kernel serves GQA verbatim with ``beams=g`` and the position-
+    validity mask from the prefetch scalar (``masked='pos'``).  The
+    cache still streams ONCE per tick (grid is (B, S-blocks); the g
+    groups iterate in-register) — GQA's inference payoff is preserved.
+
+    ``q (B, Hq·hd)`` head-major flat; ``kc/vc (B, S, Hkv·hd)``; returns
+    ``ctx (B, Hq·hd)``.  Group convention matches ``parallel/decode.py``:
+    q-head h uses KV head ``h // g`` (head-major reshape to
+    ``(Hkv, g, hd)``).
+    """
+    b, s, d_kv = kc.shape
+    g = n_q_heads // n_kv_heads
+    if n_q_heads % n_kv_heads or g < 1:
+        raise ValueError(f"bad head ratio {n_q_heads}/{n_kv_heads}")
+    # (B, Hkv, g, hd) -> group-major rows (B·g, Hkv·hd), b-major like the
+    # beam kernel's row->cache mapping expects
+    q_g = q.reshape(b, n_kv_heads, g, head_dim).transpose(0, 2, 1, 3) \
+        .reshape(b * g, n_kv_heads * head_dim)
+    # position validity rides the prefetch scalar (masked='pos') — an
+    # f32 mask operand would stream B·g·S·4 bytes per layer per tick
+    part = beam_attend_parts(q_g, kc, vc, None, pos, beams=g,
+                             n_heads=n_kv_heads, head_dim=head_dim,
+                             block_s=block_s, interpret=interpret)
+    ctx_g = merge_attend_parts([part], n_heads=n_kv_heads,
+                               head_dim=head_dim, dtype=q.dtype)
+    return ctx_g.reshape(b, g, n_kv_heads, head_dim) \
+        .transpose(0, 2, 1, 3).reshape(b, n_q_heads * head_dim)

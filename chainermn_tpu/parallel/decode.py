@@ -116,16 +116,25 @@ def _decoder_core(params, head_dim: int, axis_name: str):
                 from ..ops.flash_attention import flash_attention
                 ctx = flash_attention(q, k, v, causal=True)
                 return ctx.astype(x.dtype), (kc, vc)
-            from ..ops.decode_attention import _pick_block_s, decode_attend
-            if s_q == 1 and hl == hkv and jax.default_backend() == "tpu" \
+            from ..ops.decode_attention import (_pick_block_s,
+                                                 decode_attend,
+                                                 decode_attend_gqa)
+            if s_q == 1 and jax.default_backend() == "tpu" \
                     and _pick_block_s(kc.shape[1]) > 0:
                 # DECODE on TPU: one flash-decode Pallas pass — cache
                 # read once at full lane density (ops/decode_attention).
-                # Odd totals with no 8-aligned S-block (e.g. a max_new=1
+                # GQA groups ride the beam kernel (g query groups share
+                # one cache row, exactly the beam row mapping).  Odd
+                # totals with no 8-aligned S-block (e.g. a max_new=1
                 # probe's 513) stay on the einsum fallback below.
-                ctx = decode_attend(
-                    q.reshape(n, hl * head_dim), kc, vc, write_at,
-                    n_heads=hkv, head_dim=head_dim)
+                if hl == hkv:
+                    ctx = decode_attend(
+                        q.reshape(n, hl * head_dim), kc, vc, write_at,
+                        n_heads=hkv, head_dim=head_dim)
+                else:
+                    ctx = decode_attend_gqa(
+                        q.reshape(n, hl * head_dim), kc, vc, write_at,
+                        n_q_heads=hl, n_kv_heads=hkv, head_dim=head_dim)
                 return ctx.reshape(n, 1, hl, head_dim), (kc, vc)
             # Fallback (GQA groups, non-TPU backends): grouped einsum
             # attention against head-view reshapes of the flat cache.

@@ -148,3 +148,45 @@ class TestBeamAttendParts:
         want = acc / (l @ segt)
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    rtol=1e-5, atol=1e-6)
+
+
+class TestGQADecode:
+    def test_matches_grouped_einsum_oracle(self):
+        from chainermn_tpu.ops.decode_attention import decode_attend_gqa
+
+        rs = np.random.RandomState(2)
+        b, s, hq, hkv, hd, pos = 2, 64, 8, 2, 16, 40
+        g = hq // hkv
+        q = jnp.asarray(rs.randn(b, hq * hd), jnp.float32)
+        kc = jnp.asarray(rs.randn(b, s, hkv * hd), jnp.float32)
+        vc = jnp.asarray(rs.randn(b, s, hkv * hd), jnp.float32)
+        got = decode_attend_gqa(q, kc, vc, pos, n_q_heads=hq,
+                                n_kv_heads=hkv, head_dim=hd, block_s=16,
+                                interpret=True)
+        # the decode.py grouped-einsum fallback as oracle
+        q5 = q.reshape(b, 1, hkv, g, hd)
+        kc4 = kc.reshape(b, s, hkv, hd)
+        vc4 = vc.reshape(b, s, hkv, hd)
+        sc = jnp.einsum("bqhgd,bkhd->bhgqk", q5, kc4,
+                        preferred_element_type=jnp.float32) / (hd ** 0.5)
+        sc = jnp.where(jnp.arange(s)[None, None, None, None, :] <= pos,
+                       sc, -1e30)
+        p = jax.nn.softmax(sc, axis=-1)
+        ctx = jnp.einsum("bhgqk,bkhd->bqhgd", p.astype(vc4.dtype), vc4,
+                         preferred_element_type=jnp.float32)
+        want = ctx.reshape(b, hq * hd)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_mqa_single_kv_head(self):
+        from chainermn_tpu.ops.decode_attention import decode_attend_gqa
+
+        rs = np.random.RandomState(3)
+        b, s, hq, hkv, hd = 1, 32, 4, 1, 32
+        q = jnp.asarray(rs.randn(b, hq * hd), jnp.float32)
+        kc = jnp.asarray(rs.randn(b, s, hkv * hd), jnp.float32)
+        vc = jnp.asarray(rs.randn(b, s, hkv * hd), jnp.float32)
+        got = decode_attend_gqa(q, kc, vc, 31, n_q_heads=hq, n_kv_heads=hkv,
+                                head_dim=hd, block_s=8, interpret=True)
+        assert got.shape == (b, hq * hd)
+        assert np.isfinite(np.asarray(got)).all()
