@@ -184,6 +184,17 @@ def main():
         acc = (logits.argmax(-1) == labels).mean()
         return loss, {"accuracy": acc}
 
+    def normalize_on_chip(batch):
+        # uint8 corpora (scripts/ingest_images.py preserves uint8: 4x
+        # fewer host->device bytes) cast+normalize ON CHIP, fused into
+        # the first conv's prologue; float corpora pass through.  The
+        # dtype is static at trace time, so this is a free trace-time
+        # branch (docs/PERF.md round-5 data path).
+        images, labels = batch
+        if images.dtype == jnp.uint8:
+            images = images.astype(jnp.float32) / 255.0 - 0.5
+        return images, labels
+
     if args.fsdp:
         # ZeRO-3 path: GSPMD inserts per-use weight all-gathers and
         # gradient reduce-scatters from the 1/P shardings alone.  BN's
@@ -199,6 +210,7 @@ def main():
                 f"try --arch vit_s16")
 
         def fsdp_loss(p, batch):
+            batch = normalize_on_chip(batch)
             logits = model.apply({"params": p}, batch[0], train=True)
             loss, metrics = loss_and_metrics(logits, batch)
             return loss, metrics
@@ -215,7 +227,8 @@ def main():
     else:
         step = mn.make_flax_train_step(
             model, loss_and_metrics, optimizer, mesh=mesh,
-            allreduce_grad_dtype=args.allreduce_grad_dtype)
+            allreduce_grad_dtype=args.allreduce_grad_dtype,
+            preprocess=normalize_on_chip)
         variables = mn.replicate(dict(variables), mesh)
         opt_state = mn.replicate(optimizer.init(variables["params"]), mesh)
 

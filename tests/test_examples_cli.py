@@ -70,3 +70,36 @@ def test_example_cli_runs(script, args):
          "--devices", "8", *args],
         capture_output=True, text=True, timeout=600, env=env, cwd=ROOT)
     assert out.returncode == 0, (script, out.stderr[-2000:])
+
+
+@pytest.mark.slow
+def test_imagenet_cli_consumes_uint8_corpus(tmp_path):
+    """A uint8 corpus from the real ingest CLI trains through --data-dir:
+    the round-5 normalize_on_chip preprocess casts on device (uint8
+    records are the layout scripts/ingest_images.py preserves from image
+    dirs — 4x fewer host->device bytes than float32)."""
+    import numpy as np
+
+    rs = np.random.RandomState(0)
+    npz = tmp_path / "c.npz"
+    np.savez(npz,
+             images=rs.randint(0, 256, (128, 16, 16, 3), dtype=np.uint8),
+             labels=rs.randint(0, 10, 128).astype(np.int32))
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env["JAX_PLATFORMS"] = "cpu"
+    ing = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "scripts", "ingest_images.py"),
+         "--source", f"npz:{npz}", "--out", str(tmp_path / "ds"),
+         "--val-frac", "0.0"],
+        capture_output=True, text=True, timeout=300, env=env, cwd=ROOT)
+    assert ing.returncode == 0, ing.stderr[-1000:]
+    out = subprocess.run(
+        [sys.executable,
+         os.path.join(ROOT, "examples", "imagenet", "train_imagenet.py"),
+         "--devices", "8", "--image-size", "16", "--batchsize", "4",
+         "--steps", "2", "--num-classes", "10", "--arch", "resnet18",
+         "--data-dir", str(tmp_path / "ds" / "train")],
+        capture_output=True, text=True, timeout=600, env=env, cwd=ROOT)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "loss" in out.stdout
