@@ -32,6 +32,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -54,28 +55,43 @@ _ROWS = 8  # sublane tile: the smallest legal second-minor block
 
 
 def _append_kernel(pos_ref, knew_ref, vnew_ref, kin_ref, vin_ref,
-                   kout_ref, vout_ref):
+                   kout_ref, vout_ref, *, rows):
     """Rewrite the 8-row sublane block containing ``pos``, replacing only
-    the target row (iota-select — no dynamic stores needed)."""
-    row = pos_ref[0] % _ROWS
+    rows [pos, pos+rows) (iota-range select — no dynamic stores).
+
+    The new-row operands arrive TILED to the full 8-row block
+    (8/rows copies): because ``rows | 8`` and the caller guarantees
+    ``pos % rows == 0``, the in-block start ``pos % 8`` is a multiple of
+    ``rows``, so ``tiled[j] == new[j - start]`` for every selected row —
+    placement needs no dynamic shift at all."""
+    start = pos_ref[0] % _ROWS
     idx = jax.lax.broadcasted_iota(jnp.int32, kin_ref.shape,
                                    kin_ref.ndim - 2)
-    kout_ref[...] = jnp.where(idx == row, knew_ref[...], kin_ref[...])
-    vout_ref[...] = jnp.where(idx == row, vnew_ref[...], vin_ref[...])
+    sel = (idx >= start) & (idx < start + rows)
+    kout_ref[...] = jnp.where(sel, knew_ref[...], kin_ref[...])
+    vout_ref[...] = jnp.where(sel, vnew_ref[...], vin_ref[...])
 
 
 def cache_append(kc, vc, k_new, v_new, pos, *, axis: int = 1,
-                 impl: str = "auto", interpret: bool = False):
+                 impl: str = "auto", pos_aligned: bool = False,
+                 interpret: bool = False):
     """Write ``k_new``/``v_new`` into ``kc``/``vc`` at ``pos`` along
     ``axis``; returns the updated ``(kc, vc)``.
 
-    ``impl='auto'`` uses the Pallas one-row scatter on TPU when the write
-    is a single row (``k_new.shape[axis] == 1`` — the decode tick), and
-    the XLA ``dynamic_update_slice`` everywhere else (other backends, and
-    multi-row prefill writes where a full-pass update is amortized and
-    XLA's slab write is fine).  ``interpret=True`` (with
-    ``impl='pallas'``) runs the kernel in interpret mode for off-chip
-    parity tests.
+    ``impl='auto'`` uses the Pallas scatter on TPU when the write is
+    ``rows`` rows with ``rows | 8`` (one row = the decode tick; rows=k =
+    the time-major beam tick writing all k slots at once), and the XLA
+    ``dynamic_update_slice`` everywhere else (other backends, and slab
+    prefill writes where a full-pass update is amortized and XLA's slab
+    write is fine).  CONTRACT for rows > 1: ``pos`` must be a multiple
+    of ``rows`` (the beam tick's ``(i-1)·k`` positions are) — the
+    in-tile placement relies on it.  A concrete misaligned ``pos`` falls
+    back to the exact dus (or raises under ``impl='pallas'``); a TRACED
+    ``pos`` cannot be checked, so multi-row auto-dispatch additionally
+    requires the caller's ``pos_aligned=True`` promise — without it the
+    write takes the dus path rather than risk silent corruption.
+    ``interpret=True`` (with ``impl='pallas'``) runs the kernel in
+    interpret mode for off-chip parity tests.
     """
     if impl not in ("auto", "pallas", "xla"):
         raise ValueError(f"impl must be auto|pallas|xla, got {impl!r}")
@@ -84,8 +100,13 @@ def cache_append(kc, vc, k_new, v_new, pos, *, axis: int = 1,
     # there) with an 8-divisible extent — the mapped block is then the
     # (8, minor) sublane tile containing ``pos``, the smallest Mosaic
     # will address.
-    one_row = k_new.shape[axis] == 1
-    fits = (one_row and axis == kc.ndim - 2 and kc.shape[axis] % _ROWS == 0)
+    rows = k_new.shape[axis]
+    concrete = isinstance(pos, (int, np.integer))
+    aligned = (rows == 1
+               or (concrete and pos % rows == 0)
+               or (not concrete and pos_aligned))
+    fits = (rows >= 1 and _ROWS % rows == 0 and axis == kc.ndim - 2
+            and kc.shape[axis] % _ROWS == 0 and aligned)
     use_pallas = (impl == "pallas"
                   or (impl == "auto" and fits
                       and jax.default_backend() == "tpu"))
@@ -94,9 +115,11 @@ def cache_append(kc, vc, k_new, v_new, pos, *, axis: int = 1,
                 jax.lax.dynamic_update_slice_in_dim(vc, v_new, pos, axis))
     if not fits:
         raise ValueError(
-            f"impl='pallas' needs a single-row write along the "
-            f"second-minor axis with an 8-divisible extent; got axis "
-            f"{axis} of shape {kc.shape} writing {k_new.shape[axis]} rows")
+            f"impl='pallas' needs a write of rows dividing {_ROWS} along "
+            f"the second-minor axis with an 8-divisible extent, at a "
+            f"rows-aligned pos (traced pos needs pos_aligned=True); got "
+            f"axis {axis} of shape {kc.shape} writing "
+            f"{k_new.shape[axis]} rows at pos {pos!r}")
 
     block = tuple(_ROWS if d == axis else n for d, n in enumerate(kc.shape))
     new_block = tuple(1 if d == axis else n for d, n in enumerate(kc.shape))
@@ -109,21 +132,31 @@ def cache_append(kc, vc, k_new, v_new, pos, *, axis: int = 1,
                      for d in range(kc.ndim))
 
     vma = _inherit_vma(kc, vc, k_new, v_new)
+    # rows == 1 keeps the 1-row new-operand block (the hot greedy tick:
+    # the where broadcasts it for free); rows > 1 tiles the new rows to
+    # the full 8-row block so in-tile placement is shift-free (see
+    # _append_kernel).
+    nb = new_block if rows == 1 else block
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1, grid=(1,),
-        in_specs=[pl.BlockSpec(new_block, lambda i, p: zero_idx),
-                  pl.BlockSpec(new_block, lambda i, p: zero_idx),
+        in_specs=[pl.BlockSpec(nb, lambda i, p: zero_idx),
+                  pl.BlockSpec(nb, lambda i, p: zero_idx),
                   pl.BlockSpec(block, at_pos),
                   pl.BlockSpec(block, at_pos)],
         out_specs=[pl.BlockSpec(block, at_pos),
                    pl.BlockSpec(block, at_pos)])
-    new_shape = kc.shape[:axis] + (1,) + kc.shape[axis + 1:]
+    new_shape = kc.shape[:axis] + (rows,) + kc.shape[axis + 1:]
+    kn = k_new.reshape(new_shape).astype(kc.dtype)
+    vn = v_new.reshape(new_shape).astype(vc.dtype)
+    if rows > 1:
+        reps = tuple(_ROWS // rows if d == axis else 1
+                     for d in range(kc.ndim))
+        kn, vn = jnp.tile(kn, reps), jnp.tile(vn, reps)
+    import functools as _ft
     return pl.pallas_call(
-        _append_kernel, grid_spec=grid_spec,
+        _ft.partial(_append_kernel, rows=rows), grid_spec=grid_spec,
         out_shape=[jax.ShapeDtypeStruct(kc.shape, kc.dtype, vma=vma),
                    jax.ShapeDtypeStruct(vc.shape, vc.dtype, vma=vma)],
         input_output_aliases={3: 0, 4: 1},  # kc, vc (after the scalar arg)
         interpret=interpret,
-    )(jnp.asarray([pos], jnp.int32).astype(jnp.int32),
-      k_new.reshape(new_shape).astype(kc.dtype),
-      v_new.reshape(new_shape).astype(vc.dtype), kc, vc)
+    )(jnp.asarray([pos], jnp.int32).astype(jnp.int32), kn, vn, kc, vc)

@@ -238,56 +238,60 @@ class TestBeamSearch:
 
 
 def test_beam_kernel_slot_flattening_convention():
-    """The lazy-beam kernel path flattens the (b, k, T, d) generated
-    caches SLOT-MAJOR to (b, k*T, d) and the (b, s, l, t) ancestry mask
-    to (b, s, l*T + t) — this test pins that the two flattenings agree
-    (a transposed reshape would silently attend the wrong slots).  The
-    kernel runs in interpret mode directly (no shard_map: interpret-
-    Pallas under manual axes trips VMA checks); the full TPU path is
-    token-parity-checked against the physical-gather oracle on-chip."""
+    """The lazy-beam kernel path flattens the generated caches TIME-MAJOR
+    (row = t·k + slot) with a matching (b, s, t, l) mask and reads a
+    static live-prefix window [:t_hi·k] — this test pins that the
+    flattenings agree (a transposed reshape would silently attend the
+    wrong slots).  The kernel runs in interpret mode directly (no
+    shard_map: interpret-Pallas under manual axes trips VMA checks); the
+    full TPU path is token-parity-checked against the physical-gather
+    oracle on-chip."""
     from chainermn_tpu.ops.decode_attention import (beam_attend_parts,
                                                     merge_attend_parts)
 
     rs = np.random.RandomState(7)
-    b, k, t, h, hd, sp = 2, 3, 8, 2, 16, 16
+    b, k, t_max, h, hd, sp, t_hi = 2, 3, 16, 2, 16, 16, 8
     d = h * hd
     q = jnp.asarray(rs.randn(b * k, d), jnp.float32)
     pk = jnp.asarray(rs.randn(b, sp, d), jnp.float32)
     pv = jnp.asarray(rs.randn(b, sp, d), jnp.float32)
-    gk = jnp.asarray(rs.randn(b, k, t, d), jnp.float32)   # slot-major
-    gv = jnp.asarray(rs.randn(b, k, t, d), jnp.float32)
-    anc = jnp.asarray(rs.randint(0, k, (b, k, t)), jnp.int32)
-    valid = jnp.arange(t) < 5
-    amask = ((anc[:, :, None, :] == jnp.arange(k)[None, None, :, None])
-             & valid[None, None, None, :])                # (b, s, l, t)
+    # time-major generated rows: (b, t_max·k, d), row = t·k + l
+    gk = jnp.asarray(rs.randn(b, t_max * k, d), jnp.float32)
+    gv = jnp.asarray(rs.randn(b, t_max * k, d), jnp.float32)
+    anc = jnp.asarray(rs.randint(0, k, (b, k, t_max)), jnp.int32)
+    valid = jnp.arange(t_max) < 5                          # all < t_hi
+    amask_tl = ((anc[:, :, None, :] == jnp.arange(k)[None, None, :, None])
+                & valid[None, None, None, :]).transpose(0, 1, 3, 2)
 
-    # kernel path: EXACTLY the reshapes decode.py uses
+    # kernel path: EXACTLY the reshapes/window decode.py uses
+    gk_w, gv_w = gk[:, :t_hi * k], gv[:, :t_hi * k]
     part_p = beam_attend_parts(q, pk, pv, beams=k, n_heads=h, head_dim=hd,
                                block_s=8, interpret=True)
     part_g = beam_attend_parts(
-        q, gk.reshape(b, k * t, d), gv.reshape(b, k * t, d),
-        amask.reshape(b, k, k * t).astype(jnp.int8),
+        q, gk_w, gv_w,
+        amask_tl[:, :, :t_hi, :].reshape(b, k, t_hi * k).astype(jnp.int8),
         beams=k, n_heads=h, head_dim=hd, block_s=8, interpret=True)
     got = merge_attend_parts([part_p, part_g], n_heads=h, head_dim=hd,
                              dtype=jnp.float32)
 
-    # oracle: the einsum fallback formulas on the UN-flattened caches
+    # oracle: the einsum fallback formulas on the windowed 5-D views
     q6 = q.reshape(b, k, h, 1, hd)
     pk4 = pk.reshape(b, sp, h, hd)
     pv4 = pv.reshape(b, sp, h, hd)
-    gk5 = gk.reshape(b, k, t, h, hd)
-    gv5 = gv.reshape(b, k, t, h, hd)
+    gk5 = gk_w.reshape(b, t_hi, k, h, hd)
+    gv5 = gv_w.reshape(b, t_hi, k, h, hd)
     scale = hd ** 0.5
     s_p = jnp.einsum("bshgd,bthd->bshgt", q6, pk4,
                      preferred_element_type=jnp.float32) / scale
-    s_g = jnp.einsum("bshgd,blthd->bshglt", q6, gk5,
+    s_g = jnp.einsum("bshgd,btlhd->bshgtl", q6, gk5,
                      preferred_element_type=jnp.float32) / scale
-    s_g = jnp.where(amask[:, :, None, None, :, :], s_g, -1e30)
-    joint = jnp.concatenate([s_p, s_g.reshape(b, k, h, 1, k * t)], axis=-1)
+    s_g = jnp.where(amask_tl[:, :, None, None, :t_hi, :], s_g, -1e30)
+    joint = jnp.concatenate([s_p, s_g.reshape(b, k, h, 1, t_hi * k)],
+                            axis=-1)
     p = jax.nn.softmax(joint, axis=-1)
     ctx = (jnp.einsum("bshgt,bthd->bshgd", p[..., :sp], pv4,
                       preferred_element_type=jnp.float32)
-           + jnp.einsum("bshglt,blthd->bshgd",
+           + jnp.einsum("bshgtl,btlhd->bshgd",
                         p[..., sp:].reshape(s_g.shape), gv5,
                         preferred_element_type=jnp.float32))
     want = ctx.reshape(b * k, d)

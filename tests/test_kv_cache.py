@@ -85,3 +85,31 @@ def test_envelope_rejections_and_fallback():
         np.asarray(jax.lax.dynamic_update_slice_in_dim(kc, kn + 1, 3, 1)))
     with pytest.raises(ValueError, match="impl"):
         cache_append(kc, kc, kn, kn, 3, impl="bogus")
+
+
+@pytest.mark.parametrize("rows,pos", [(2, 0), (2, 6), (2, 30), (4, 8),
+                                      (4, 28), (8, 16)])
+def test_multi_row_range_scatter(rows, pos):
+    """rows|8 writes at rows-aligned positions (the time-major beam tick
+    writes all k slots' rows [(i-1)k, ik) in one call)."""
+    b, s, d = 2, 32, 16
+    kc, vc = _mk((b, s, d), jnp.float32, 20), _mk((b, s, d), jnp.float32, 21)
+    kn, vn = (_mk((b, rows, d), jnp.float32, 22),
+              _mk((b, rows, d), jnp.float32, 23))
+    got_k, got_v = cache_append(kc, vc, kn, vn, pos, axis=1,
+                                impl="pallas", interpret=True)
+    want_k = jax.lax.dynamic_update_slice_in_dim(kc, kn, pos, 1)
+    want_v = jax.lax.dynamic_update_slice_in_dim(vc, vn, pos, 1)
+    np.testing.assert_array_equal(np.asarray(got_k), np.asarray(want_k))
+    np.testing.assert_array_equal(np.asarray(got_v), np.asarray(want_v))
+
+
+def test_rows_not_dividing_8_falls_back():
+    kc = jnp.zeros((2, 32, 16))
+    kn = jnp.ones((2, 3, 16))
+    got, _ = cache_append(kc, kc, kn, kn, 6, axis=1, impl="auto")
+    np.testing.assert_array_equal(
+        np.asarray(got),
+        np.asarray(jax.lax.dynamic_update_slice_in_dim(kc, kn, 6, 1)))
+    with pytest.raises(ValueError, match="rows dividing"):
+        cache_append(kc, kc, kn, kn, 6, axis=1, impl="pallas")
