@@ -45,47 +45,21 @@ import time
 
 REFERENCE_IMAGES_PER_SEC_PER_CHIP = 125.0  # ChainerMN 1024xP100 headline run
 
-# Peak dense bf16 FLOP/s per chip by TPU generation (public spec sheets).
-# Matched by substring against jax.devices()[0].device_kind (lowercased).
-PEAK_BF16_FLOPS = [
-    ("v6e", 918e12),
-    ("trillium", 918e12),
-    ("v5p", 459e12),
-    ("v5e", 197e12),
-    ("v5 lite", 197e12),
-    ("v4", 275e12),
-    ("v3", 123e12),
-    ("v2", 46e12),
-]
 
-
-# HBM bandwidth (bytes/s) per chip by TPU generation (public spec sheets).
-HBM_BYTES_PER_S = [
-    ("v6e", 1.64e12),
-    ("trillium", 1.64e12),
-    ("v5p", 2.765e12),
-    ("v5e", 8.19e11),
-    ("v5 lite", 8.19e11),
-    ("v4", 1.228e12),
-    ("v3", 9.0e11),
-    ("v2", 7.0e11),
-]
-
+# The per-generation peak-FLOPs / HBM-bandwidth tables moved to
+# chainermn_tpu.observability.metrics (single source of truth shared with
+# the step-breakdown MFU gauge); these thin faces keep bench.py's import
+# graph lazy — chainermn_tpu is only pulled in once a benchmark actually
+# needs it.
 
 def peak_flops_for(device_kind: str):
-    kind = device_kind.lower()
-    for key, peak in PEAK_BF16_FLOPS:
-        if key in kind:
-            return peak
-    return None  # CPU / unknown: MFU not meaningful
+    from chainermn_tpu.observability.metrics import peak_flops_for as _f
+    return _f(device_kind)
 
 
 def hbm_bw_for(device_kind: str):
-    kind = device_kind.lower()
-    for key, bw in HBM_BYTES_PER_S:
-        if key in kind:
-            return bw
-    return None
+    from chainermn_tpu.observability.metrics import hbm_bw_for as _f
+    return _f(device_kind)
 
 
 def build_step(arch, image_size, per_chip_batch, allreduce_grad_dtype=None,
@@ -182,6 +156,8 @@ def measure(step, variables, opt_state, batch, steps, epochs=2,
     time-shared virtual mesh cannot publish a >100% efficiency point
     (round-4 artifact carried a single-sample 116.9%).
     """
+    if reduce not in ("max", "median"):
+        raise ValueError(f"reduce must be 'max' or 'median', got {reduce!r}")
     for _ in range(2):  # compile + warmup
         variables, opt_state, loss, *_ = step(variables, opt_state, batch)
     float(loss)
@@ -817,6 +793,11 @@ def main():
     parser.add_argument("--full-sweep", action="store_true",
                         help="include the n=16/32 virtual-mesh points "
                              "(slow; measures host scheduling only)")
+    parser.add_argument("--trace-out", default=None,
+                        help="enable the observability tracer and write a "
+                             "Chrome-trace/Perfetto JSON here (re-exported "
+                             "after every section, so a killed run still "
+                             "leaves a loadable artifact)")
     args = parser.parse_args()
 
     if args.scaling_worker is not None:
@@ -845,6 +826,11 @@ def main():
 
     if os.environ.get("JAX_PLATFORMS") == "cpu":
         jax.config.update("jax_platforms", "cpu")
+
+    obs = None
+    if args.trace_out:
+        from chainermn_tpu import observability as obs
+        obs.enable()
 
     dev = jax.devices()[0]
     on_tpu = dev.platform == "tpu"
@@ -1076,6 +1062,10 @@ def main():
         result["wall_clock_s"] = round(time.time() - t_start, 1)
         print(json.dumps(result), flush=True)
         print(compact_line(), flush=True)
+        if obs is not None:
+            if section:
+                obs.instant(f"section/{section}", cat="bench")
+            obs.export_chrome_trace(args.trace_out)
 
     emit("headline")
 

@@ -46,8 +46,30 @@ import jax
 import numpy as np
 
 
+#: Concrete collectives auto-wrapped with observability accounting when a
+#: backend defines them (op name, payload bytes, host latency — see
+#: observability/comm.py).  Object-lane transport is deliberately absent:
+#: it is a setup path, and pickled payload sizes say nothing about wire
+#: collectives.
+_ACCOUNTED_OPS = (
+    "allreduce", "bcast", "gather", "allgather", "alltoall", "scatter",
+    "send", "recv", "broadcast_data", "multi_node_mean_grad",
+)
+
+
 class CommunicatorBase:
     """API contract shared by every communicator backend."""
+
+    def __init_subclass__(cls, **kwargs):
+        # Every backend (naive, xla, future ones) gets comm accounting on
+        # its eager collectives without per-backend boilerplate; the
+        # wrapper is one attribute read when tracing is disabled.
+        super().__init_subclass__(**kwargs)
+        from ..observability.comm import accounted_method
+        for name in _ACCOUNTED_OPS:
+            fn = cls.__dict__.get(name)
+            if callable(fn) and not getattr(fn, "_obs_wrapped", False):
+                setattr(cls, name, accounted_method(name)(fn))
 
     # ---- topology properties (reference: communicator_base.py [uv]) ----
     @property

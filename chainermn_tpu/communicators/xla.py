@@ -28,9 +28,9 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Union
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .._compat import shard_map
 from ..topology import DEFAULT_AXIS_NAME, Topology, make_mesh
 from .base import CommunicatorBase
 

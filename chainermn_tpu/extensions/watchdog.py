@@ -116,6 +116,16 @@ class Watchdog:
                 continue
             gap = time.monotonic() - last
             if gap > self.timeout:
+                # Name the last COMPLETED unit of work so the stall
+                # report says WHERE the job wedged (the stuck unit is
+                # whatever comes after it) — fed by the trainer's phase
+                # stamps (observability step-breakdown layer).
+                phase = getattr(self._trainer, "last_phase", None)
+                if phase is not None:
+                    print(f"[chainermn_tpu watchdog] last completed "
+                          f"phase: {phase} at iteration "
+                          f"{getattr(self._trainer, 'iteration', '?')}",
+                          file=sys.stderr, flush=True)
                 self.action(gap, self.timeout)
                 return
 

@@ -1,0 +1,83 @@
+"""Unified tracing + metrics layer.
+
+Three pieces (docs/OBSERVABILITY.md is the user guide):
+
+* :mod:`.trace` — nested span tracer with counters/gauges and a
+  Chrome-trace / Perfetto JSON exporter; no-op when disabled.
+* :mod:`.comm` — collective-communication accounting threaded through
+  the in-jit collective face (``chainermn_tpu.ops.collective``) and the
+  eager communicators (op, axis, payload bytes, dtype, host latency).
+* :mod:`.metrics` — step-time breakdown / throughput / MFU published
+  through the trainer observation path so the values are rank-aggregated
+  like any other metric.
+
+Quick start::
+
+    import chainermn_tpu as mn
+    mn.observability.enable()
+    ... train ...
+    mn.observability.export_chrome_trace("trace.json")   # load in Perfetto
+    print(mn.observability.comm_report())                # bytes per collective
+"""
+
+from .trace import (  # noqa: F401
+    Tracer,
+    add_counter,
+    disable,
+    enable,
+    enabled,
+    export_chrome_trace,
+    get_tracer,
+    instant,
+    reset,
+    set_gauge,
+    span,
+    traced,
+)
+from .comm import (  # noqa: F401
+    CommAccountant,
+    accounted_method,
+    collective,
+    get_accountant,
+)
+from .metrics import (  # noqa: F401
+    StepBreakdownReport,
+    hbm_bw_for,
+    peak_flops_for,
+)
+
+
+def comm_report():
+    """Cumulative per-collective byte/call/latency totals."""
+    return get_accountant().report()
+
+
+def reset_all() -> None:
+    """Clear trace events AND comm totals (tests, fresh capture)."""
+    reset()
+    get_accountant().reset()
+
+
+__all__ = [
+    "Tracer",
+    "enable",
+    "disable",
+    "enabled",
+    "reset",
+    "reset_all",
+    "span",
+    "traced",
+    "instant",
+    "add_counter",
+    "set_gauge",
+    "get_tracer",
+    "export_chrome_trace",
+    "CommAccountant",
+    "get_accountant",
+    "collective",
+    "accounted_method",
+    "comm_report",
+    "StepBreakdownReport",
+    "peak_flops_for",
+    "hbm_bw_for",
+]

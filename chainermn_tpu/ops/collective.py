@@ -21,6 +21,9 @@ from typing import Optional
 
 import jax
 
+from .._compat import axis_size as _axis_size_compat
+from .._compat import pcast_varying as _pcast_varying
+from ..observability.comm import collective as _acc
 from ..topology import DEFAULT_AXIS_NAME
 
 
@@ -56,24 +59,34 @@ def zeros_like_vma(x, dtype=None, shape=None):
                   x.dtype if dtype is None else dtype)
     vma = getattr(getattr(x, "aval", None), "vma", None)
     if vma:
-        z = jax.lax.pcast(z, tuple(vma), to="varying")
+        z = _pcast_varying(z, tuple(vma))
     return z
 
 
+# Every public collective routes through the observability accounting
+# (`observability.comm.collective`): op name, axis, payload bytes and wire
+# dtype are booked per call — once per trace for in-jit calls, with host
+# latency for eager ones.  With tracing disabled the wrapper is a single
+# attribute read before dispatching to `jax.lax`.
+
 def psum(x, axis_name: str = DEFAULT_AXIS_NAME):
-    return jax.tree_util.tree_map(lambda v: jax.lax.psum(v, axis_name), x)
+    return _acc("psum", axis_name, x, lambda: jax.tree_util.tree_map(
+        lambda v: jax.lax.psum(v, axis_name), x))
 
 
 def pmean(x, axis_name: str = DEFAULT_AXIS_NAME):
-    return jax.tree_util.tree_map(lambda v: jax.lax.pmean(v, axis_name), x)
+    return _acc("pmean", axis_name, x, lambda: jax.tree_util.tree_map(
+        lambda v: jax.lax.pmean(v, axis_name), x))
 
 
 def pmax(x, axis_name: str = DEFAULT_AXIS_NAME):
-    return jax.tree_util.tree_map(lambda v: jax.lax.pmax(v, axis_name), x)
+    return _acc("pmax", axis_name, x, lambda: jax.tree_util.tree_map(
+        lambda v: jax.lax.pmax(v, axis_name), x))
 
 
 def pmin(x, axis_name: str = DEFAULT_AXIS_NAME):
-    return jax.tree_util.tree_map(lambda v: jax.lax.pmin(v, axis_name), x)
+    return _acc("pmin", axis_name, x, lambda: jax.tree_util.tree_map(
+        lambda v: jax.lax.pmin(v, axis_name), x))
 
 
 def pmean_if_bound(x, axis_name: Optional[str] = DEFAULT_AXIS_NAME):
@@ -90,30 +103,34 @@ def pmean_if_bound(x, axis_name: Optional[str] = DEFAULT_AXIS_NAME):
 
 
 def all_gather(x, axis_name: str = DEFAULT_AXIS_NAME, axis: int = 0, tiled: bool = True):
-    return jax.lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+    return _acc("all_gather", axis_name, x, lambda: jax.lax.all_gather(
+        x, axis_name, axis=axis, tiled=tiled))
 
 
 def all_to_all(x, axis_name: str = DEFAULT_AXIS_NAME, split_axis: int = 0,
                concat_axis: int = 0, tiled: bool = True):
-    return jax.lax.all_to_all(x, axis_name, split_axis=split_axis,
-                              concat_axis=concat_axis, tiled=tiled)
+    return _acc("all_to_all", axis_name, x, lambda: jax.lax.all_to_all(
+        x, axis_name, split_axis=split_axis, concat_axis=concat_axis,
+        tiled=tiled))
 
 
 def reduce_scatter(x, axis_name: str = DEFAULT_AXIS_NAME, scatter_axis: int = 0):
-    return jax.lax.psum_scatter(x, axis_name, scatter_dimension=scatter_axis,
-                                tiled=True)
+    return _acc("reduce_scatter", axis_name, x, lambda: jax.lax.psum_scatter(
+        x, axis_name, scatter_dimension=scatter_axis, tiled=True))
 
 
 def ppermute(x, perm, axis_name: str = DEFAULT_AXIS_NAME):
-    return jax.lax.ppermute(x, axis_name, perm=perm)
+    return _acc("ppermute", axis_name, x, lambda: jax.lax.ppermute(
+        x, axis_name, perm=perm))
 
 
 def shift(x, offset: int, axis_name: str = DEFAULT_AXIS_NAME, size: Optional[int] = None):
     """Ring shift by `offset` (the ring-attention / pipeline building block)."""
     if size is None:
-        size = jax.lax.axis_size(axis_name)
+        size = _axis_size_compat(axis_name)
     perm = [(i, (i + offset) % size) for i in range(size)]
-    return jax.lax.ppermute(x, axis_name, perm=perm)
+    return _acc("shift", axis_name, x, lambda: jax.lax.ppermute(
+        x, axis_name, perm=perm))
 
 
 def axis_index(axis_name: str = DEFAULT_AXIS_NAME):
@@ -121,7 +138,7 @@ def axis_index(axis_name: str = DEFAULT_AXIS_NAME):
 
 
 def axis_size(axis_name: str = DEFAULT_AXIS_NAME) -> int:
-    return jax.lax.axis_size(axis_name)
+    return _axis_size_compat(axis_name)
 
 
 def bcast(x, root: int = 0, axis_name: str = DEFAULT_AXIS_NAME):
@@ -129,7 +146,8 @@ def bcast(x, root: int = 0, axis_name: str = DEFAULT_AXIS_NAME):
     def one(v):
         g = jax.lax.all_gather(v, axis_name, axis=0, tiled=False)
         return g[root]
-    return jax.tree_util.tree_map(one, x)
+    return _acc("bcast", axis_name, x,
+                lambda: jax.tree_util.tree_map(one, x))
 
 
 def quantized_ring_pmean(x, axis_name: str = DEFAULT_AXIS_NAME,
@@ -151,7 +169,7 @@ def quantized_ring_pmean(x, axis_name: str = DEFAULT_AXIS_NAME,
     """
     import jax.numpy as jnp
 
-    p = jax.lax.axis_size(axis_name)
+    p = _axis_size_compat(axis_name)
     if p == 1:
         return x
     wire = jnp.dtype(wire_dtype)
@@ -207,7 +225,11 @@ def quantized_ring_pmean(x, axis_name: str = DEFAULT_AXIS_NAME,
         flat_out = deq.ravel()[:n] / p
         return flat_out.reshape(leaf.shape).astype(leaf.dtype)
 
-    return jax.tree_util.tree_map(one, x)
+    # Accounted at the WIRE dtype: the whole point of this op is that the
+    # ring hops carry int8, so the byte ledger reflects ~1 byte/element,
+    # not x's fp32 logical payload.
+    return _acc("quantized_ring_pmean", axis_name, x,
+                lambda: jax.tree_util.tree_map(one, x), wire_dtype=wire)
 
 
 def hierarchical_pmean(x, chip_axis: str = "chip", slice_axis: str = "slice",
@@ -238,4 +260,6 @@ def hierarchical_pmean(x, chip_axis: str = "chip", slice_axis: str = "slice",
             wire = jnp.dtype(dcn_dtype)
             return jax.lax.pmean(local.astype(wire), slice_axis).astype(v.dtype)
         return jax.lax.pmean(local, slice_axis)       # DCN, once
-    return jax.tree_util.tree_map(one, x)
+    return _acc("hierarchical_pmean", (chip_axis, slice_axis), x,
+                lambda: jax.tree_util.tree_map(one, x),
+                wire_dtype=dcn_dtype)

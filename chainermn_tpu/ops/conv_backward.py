@@ -56,9 +56,14 @@ import functools
 from typing import Tuple
 
 import jax
+
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+
+from .._compat import pcast_varying as _pcast_varying
+from .._compat import shape_dtype_struct as _sds
+from .._compat import tpu_compiler_params as _tpu_compiler_params
 
 __all__ = ["conv2d", "conv3x3_dgrad", "conv3x3_wgrad"]
 
@@ -96,10 +101,7 @@ def _promote_vma(x, vma: frozenset):
     missing = tuple(sorted(set(vma) - set(have)))
     if not missing:
         return x
-    pcast = getattr(jax.lax, "pcast", None)
-    if pcast is not None:
-        return pcast(x, missing, to="varying")
-    return jax.lax.pvary(x, missing)
+    return _pcast_varying(x, missing)
 
 
 def _same_pad(h: int, k: int, s: int) -> Tuple[int, int]:
@@ -250,9 +252,9 @@ def conv3x3_wgrad(x, dy, stride: int = 1, *, ksize: int = 3,
                         if ksize > 1 else []),
         out_specs=pl.BlockSpec((ksize * ksize, ci, co),
                                lambda i, t: (0, 0, 0)),
-        out_shape=jax.ShapeDtypeStruct((ksize * ksize, ci, co), jnp.float32,
+        out_shape=_sds((ksize * ksize, ci, co), jnp.float32,
                                        vma=vma),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_tpu_compiler_params(
             dimension_semantics=("arbitrary", "arbitrary")),
         interpret=interpret,
     )(_promote_vma(x.reshape(n, h * w, ci), vma),
@@ -323,12 +325,12 @@ def conv3x3_dgrad(dy, w, xshape, stride: int = 1, *,
             pl.BlockSpec((k * k, ci, co), lambda i, t: (0, 0, 0)),
         ],
         out_specs=pl.BlockSpec((bn, h * ww_, ci), lambda i, t: (i, 0, 0)),
-        out_shape=jax.ShapeDtypeStruct((n, h * ww_, ci), dy.dtype,
+        out_shape=_sds((n, h * ww_, ci), dy.dtype,
                                        vma=vma),
         scratch_shapes=([pltpu.VMEM((bn, sp, ci), jnp.float32),
                          pltpu.VMEM((bn, sp, co), jnp.float32)]
                         if k > 1 else []),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_tpu_compiler_params(
             dimension_semantics=("arbitrary", "arbitrary")),
         interpret=interpret,
     )(_promote_vma(dy.reshape(n, h * ww_, co), vma),

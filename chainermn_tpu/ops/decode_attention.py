@@ -43,9 +43,12 @@ from __future__ import annotations
 import functools
 
 import jax
+
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+
+from .._compat import shape_dtype_struct as _sds
 
 __all__ = ["decode_attend", "decode_attend_gqa",
            "beam_attend_parts", "merge_attend_parts"]
@@ -179,7 +182,7 @@ def decode_attend(q, kc, vc, pos, *, n_heads: int, head_dim: int,
         functools.partial(_kernel, block_s=bs, n_blocks=n_blocks,
                           scale=scale),
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((b, d), q.dtype, vma=vma),
+        out_shape=_sds((b, d), q.dtype, vma=vma),
         interpret=interpret,
     )(jnp.asarray([pos], jnp.int32), q, kc, vc, seg, seg.T)
 
@@ -269,6 +272,12 @@ def beam_attend_parts(q, kc, vc, amask=None, pos=None, *, beams: int,
     ``(acc (B·beams, D) f32 unnormalized, m (B·beams, H) f32,
     l (B·beams, H) f32)``; merge segments with the flash combine
     (see ``merge_attend_parts``).
+
+    Masking uses a finite ``-1e30`` sentinel, so a row with NO valid
+    position in ``amask`` still yields finite ``(acc, m, l)`` that the
+    merge cannot tell from real data — at least one segment per row must
+    contain a valid position (``merge_attend_parts`` documents the same
+    precondition; the always-present prompt segment satisfies it).
     """
     bk, d = q.shape
     b, s, _ = kc.shape
@@ -316,9 +325,9 @@ def beam_attend_parts(q, kc, vc, amask=None, pos=None, *, beams: int,
         functools.partial(_beam_kernel, beams=beams, block_s=bs,
                           n_blocks=n_blocks, scale=scale, masked=masked),
         grid_spec=grid_spec,
-        out_shape=[jax.ShapeDtypeStruct((bk, d), jnp.float32, vma=vma),
-                   jax.ShapeDtypeStruct((bk, h), jnp.float32, vma=vma),
-                   jax.ShapeDtypeStruct((bk, h), jnp.float32, vma=vma)],
+        out_shape=[_sds((bk, d), jnp.float32, vma=vma),
+                   _sds((bk, h), jnp.float32, vma=vma),
+                   _sds((bk, h), jnp.float32, vma=vma)],
         interpret=interpret,
     )(jnp.asarray([0 if pos is None else pos], jnp.int32), q, kc, vc,
       seg, seg.T, amask.astype(jnp.float32))
@@ -326,7 +335,21 @@ def beam_attend_parts(q, kc, vc, amask=None, pos=None, *, beams: int,
 
 def merge_attend_parts(parts, n_heads: int, head_dim: int, dtype):
     """Flash combine of ≥2 ``(acc, m, l)`` segments → normalized context
-    ``(B·beams, H·hd)`` in ``dtype``."""
+    ``(B·beams, H·hd)`` in ``dtype``.
+
+    PRECONDITION: every output row must have at least one VALID (unmasked)
+    key position across the segments.  A fully-masked row cannot be
+    detected here — masking uses a finite ``-1e30`` sentinel, so such a
+    row arrives with ``m = -1e30`` and ``l = S`` (every masked score
+    contributes ``exp(0)``), which is indistinguishable from real data and
+    would merge into silently junk context.  Every in-tree caller
+    satisfies this: the prompt segment is always present and position 0 is
+    always valid (``decode_attend``/``beam_attend_parts`` mask by
+    ``pos``-validity or ancestry, never the whole row).  The ``l > 0``
+    guard below only covers the benign exact-zero case (an all-zero
+    partial segment from :func:`zeros_like` initialization), returning
+    zeros instead of 0/0 NaNs.
+    """
     d = n_heads * head_dim
     seg_t = _seg(d, n_heads).T
 
@@ -340,7 +363,9 @@ def merge_attend_parts(parts, n_heads: int, head_dim: int, dtype):
         a = jnp.exp(m_i - m)
         l_tot = l_tot + l_i * a
         acc_tot = acc_tot + acc * lanes(a)
-    return (acc_tot / lanes(l_tot)).astype(dtype)
+    den = lanes(l_tot)
+    ctx = acc_tot / jnp.maximum(den, 1e-30)
+    return jnp.where(den > 0, ctx, 0.0).astype(dtype)
 
 
 def decode_attend_gqa(q, kc, vc, pos, *, n_q_heads: int, n_kv_heads: int,

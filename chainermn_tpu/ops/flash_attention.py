@@ -42,9 +42,13 @@ import functools
 from typing import Optional
 
 import jax
+
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+
+from .._compat import shape_dtype_struct as _sds
+from .._compat import tpu_compiler_params as _tpu_compiler_params
 
 NEG_INF = -1e30
 _LANES = 128  # TPU vector lane count: scratch vectors are (block_q, 128)
@@ -232,15 +236,15 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret, seq_len,
             pl.BlockSpec((1, bq, _LANES), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, s, d), q.dtype, vma=vma),
-            jax.ShapeDtypeStruct((bh, s, _LANES), jnp.float32, vma=vma),
+            _sds((bh, s, d), q.dtype, vma=vma),
+            _sds((bh, s, _LANES), jnp.float32, vma=vma),
         ],
         scratch_shapes=[
             pltpu.VMEM((bq, d), jnp.float32),
             pltpu.VMEM((bq, _LANES), jnp.float32),
             pltpu.VMEM((bq, _LANES), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(q, k, v)
@@ -448,13 +452,13 @@ def _bwd_pallas(q, k, v, out, lse, do, causal, scale, block_q, block_k,
             # ~0.5% relative, inside bf16 training noise, and pinned by
             # the bf16 gradient parity test; fp32 callers (ring
             # attention's fp32-grade parity) keep a full-precision slab
-            jax.ShapeDtypeStruct((bh, nk, s, d), q.dtype, vma=vma),
-            jax.ShapeDtypeStruct((bh_kv, s, d), k.dtype, vma=vma),
-            jax.ShapeDtypeStruct((bh_kv, s, d), v.dtype, vma=vma),
+            _sds((bh, nk, s, d), q.dtype, vma=vma),
+            _sds((bh_kv, s, d), k.dtype, vma=vma),
+            _sds((bh_kv, s, d), v.dtype, vma=vma),
         ],
         scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
                         pltpu.VMEM((bk, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary",
                                  "arbitrary")),
         interpret=interpret,

@@ -34,9 +34,13 @@ import functools
 from typing import Optional
 
 import jax
+
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+
+from .._compat import shape_dtype_struct as _sds
+from .._compat import tpu_compiler_params as _tpu_compiler_params
 
 from .flash_attention import _inherit_vma, _pick_aligned_block, _LANES
 
@@ -216,11 +220,11 @@ def ce_stats(h, table, targets, block_t: int = 256, block_v: int = 1024,
             pl.BlockSpec((bt, _LANES), lambda i, j: (i, 0)),
             pl.BlockSpec((bt, _LANES), lambda i, j: (i, 0)),
         ],
-        out_shape=[jax.ShapeDtypeStruct((t, _LANES), jnp.float32, vma=vma)
+        out_shape=[_sds((t, _LANES), jnp.float32, vma=vma)
                    for _ in range(3)],
         scratch_shapes=[pltpu.VMEM((bt, _LANES), jnp.float32)
                         for _ in range(3)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_tpu_compiler_params(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
     )(h, table, tgt_row)
@@ -259,9 +263,9 @@ def ce_grads(h, table, targets, lse, dnll, block_t: int = 256,
             pl.BlockSpec((1, 1, t), lambda i, j: (0, 0, 0)),
         ],
         out_specs=pl.BlockSpec((bt, d), lambda i, j: (i, 0)),
-        out_shape=jax.ShapeDtypeStruct((t, d), h.dtype, vma=vma),
+        out_shape=_sds((t, d), h.dtype, vma=vma),
         scratch_shapes=[pltpu.VMEM((bt, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_tpu_compiler_params(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
     )(h, table, tgt_row, lse_row, dnll_row)
@@ -278,9 +282,9 @@ def ce_grads(h, table, targets, lse, dnll, block_t: int = 256,
             pl.BlockSpec((1, 1, t), lambda j, i: (0, 0, 0)),
         ],
         out_specs=pl.BlockSpec((bv, d), lambda j, i: (j, 0)),
-        out_shape=jax.ShapeDtypeStruct((v, d), table.dtype, vma=vma),
+        out_shape=_sds((v, d), table.dtype, vma=vma),
         scratch_shapes=[pltpu.VMEM((bv, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_tpu_compiler_params(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
     )(table, h, tgt_row, lse_row, dnll_row)

@@ -31,10 +31,13 @@ parity-tested off-chip in interpret mode (tests/test_kv_cache.py,
 from __future__ import annotations
 
 import jax
+
 import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+
+from .._compat import shape_dtype_struct as _sds
 
 __all__ = ["cache_append"]
 
@@ -120,6 +123,15 @@ def cache_append(kc, vc, k_new, v_new, pos, *, axis: int = 1,
             f"rows-aligned pos (traced pos needs pos_aligned=True); got "
             f"axis {axis} of shape {kc.shape} writing "
             f"{k_new.shape[axis]} rows at pos {pos!r}")
+    if not interpret and jax.default_backend() != "tpu":
+        # Forced pallas off-chip: fail at dispatch with an actionable
+        # message instead of deep in Mosaic lowering (ADVICE round 5) —
+        # compiled Pallas is TPU-only.
+        raise ValueError(
+            f"impl='pallas' with interpret=False requires a TPU backend "
+            f"(current backend: {jax.default_backend()!r}); pass "
+            f"interpret=True for off-chip parity runs, or impl='auto'/"
+            f"'xla' to take the dynamic_update_slice path")
 
     block = tuple(_ROWS if d == axis else n for d, n in enumerate(kc.shape))
     new_block = tuple(1 if d == axis else n for d, n in enumerate(kc.shape))
@@ -155,8 +167,8 @@ def cache_append(kc, vc, k_new, v_new, pos, *, axis: int = 1,
     import functools as _ft
     return pl.pallas_call(
         _ft.partial(_append_kernel, rows=rows), grid_spec=grid_spec,
-        out_shape=[jax.ShapeDtypeStruct(kc.shape, kc.dtype, vma=vma),
-                   jax.ShapeDtypeStruct(vc.shape, vc.dtype, vma=vma)],
+        out_shape=[_sds(kc.shape, kc.dtype, vma=vma),
+                   _sds(vc.shape, vc.dtype, vma=vma)],
         input_output_aliases={3: 0, 4: 1},  # kc, vc (after the scalar arg)
         interpret=interpret,
     )(jnp.asarray([pos], jnp.int32).astype(jnp.int32), kn, vn, kc, vc)

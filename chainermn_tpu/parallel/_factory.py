@@ -12,8 +12,9 @@ from functools import partial
 from typing import Callable, Optional
 
 import jax
-from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .._compat import shard_map
 
 NEG_INF = -1e30
 

@@ -29,6 +29,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .._compat import pcast_varying
 from .tensor_parallel import row_parallel_dense
 from .transformer import _layer_norm, _project_qkv, apply_rope
 
@@ -202,8 +203,7 @@ def _prefill(params, embed, attn_block, prompt, total: int, head_dim: int):
 def _make_face(mesh: Optional[Mesh], axis_name: str, inner, has_rng: bool):
     """Shared jit face for the generators: resolve the mesh, cache one
     compiled shard_map program per param STRUCTURE, device_put per spec."""
-    from jax import shard_map
-
+    from .._compat import shard_map
     from .transformer import transformer_lm_specs
 
     if mesh is None:
@@ -469,10 +469,7 @@ def _beam_lazy(params, prompt, embed, attn_block, block_with, global_topk, *,
         # params) into these buffers, so the initial carry must already
         # carry the varying-manual-axes type
         z = jnp.zeros(shape, dtype)
-        pcast = getattr(jax.lax, "pcast", None)
-        if pcast is not None:
-            return pcast(z, axis_name, to="varying")
-        return jax.lax.pvary(z, axis_name)
+        return pcast_varying(z, axis_name)
 
     # TIME-MAJOR flat generated caches: row t·k + slot.  Valid rows are a
     # contiguous PREFIX [0, i·k) — and a leading-prefix slice into a
@@ -606,10 +603,13 @@ def _beam_lazy(params, prompt, embed, attn_block, block_with, global_topk, *,
 
     if max_new_tokens > 1:
         # STAGED scans: stage ticks [lo, hi) read only the live-prefix
-        # window [:hi·k] of the generated caches — on average ~5/8 of
-        # the full-segment traffic at 4 stages (always-full reads were
-        # ~half dead; the prefix slice is copy-free).  One tick body
-        # compiles per stage.
+        # window [:hi·k] of the generated caches (always-full reads were
+        # ~half dead; the prefix slice is copy-free).  The chunk
+        # heuristic below yields max_new/128 stages for 128-multiples
+        # (e.g. 4 stages at 512 → ~5/8 of full-segment traffic), exactly
+        # 2 stages for other even counts ≥ 8 (~3/4 of the traffic), and
+        # a single full-window scan otherwise.  One tick body compiles
+        # per stage, so finer chunking trades compile time for traffic.
         if max_new_tokens % 128 == 0:
             chunk = 128
         elif max_new_tokens % 2 == 0 and max_new_tokens >= 8:

@@ -29,8 +29,9 @@ from typing import Callable, Optional
 
 import jax
 import optax
-from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .._compat import shard_map
 
 
 def _data_axis(mesh: Mesh, axis_name: Optional[str]) -> str:

@@ -37,6 +37,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from .._compat import pcast_varying
+
 
 def pipeline_apply(stage_fn: Callable, stage_params, x, *, axis_name: str,
                    num_microbatches: int, squeeze_stage_axis: bool = True,
@@ -104,10 +106,7 @@ def pipeline_apply(stage_fn: Callable, stage_params, x, *, axis_name: str,
     # stage-dependent writes), so the initial carry must carry that type too.
     def varying_zeros(shape, dtype):
         z = jnp.zeros(shape, dtype)
-        pcast = getattr(jax.lax, "pcast", None)
-        if pcast is not None:
-            return pcast(z, axis_name, to="varying")
-        return jax.lax.pvary(z, axis_name)
+        return pcast_varying(z, axis_name)
 
     state0 = varying_zeros(mb.shape[1:], mb.dtype)
     out0 = varying_zeros(mb.shape, mb.dtype)
@@ -172,10 +171,7 @@ def pipeline_1f1b_grads(stage_fn: Callable, loss_fn: Callable, stage_params,
         # Idempotent: zeros_like(sharded input) is already axis-varying and
         # pcast/pvary reject a varying→varying cast.
         try:
-            pcast = getattr(jax.lax, "pcast", None)
-            if pcast is not None:
-                return pcast(z, axis_name, to="varying")
-            return jax.lax.pvary(z, axis_name)
+            return pcast_varying(z, axis_name)
         except ValueError:
             return z
 

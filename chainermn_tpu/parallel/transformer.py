@@ -31,6 +31,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from .._compat import pcast_varying
 from .tensor_parallel import column_parallel_dense, row_parallel_dense, tp_mlp
 
 
@@ -312,9 +313,9 @@ def vocab_parallel_logits_loss(h, table, targets, *, axis_name: str,
         if vma_active:
             union = hv | tv | {axis_name}
             for ax in sorted(union - hv):
-                h2 = jax.lax.pcast(h2, ax, to="varying")
+                h2 = pcast_varying(h2, ax)
             for ax in sorted(union - tv):
-                table = jax.lax.pcast(table, ax, to="varying")
+                table = pcast_varying(table, ax)
         local_t = (targets - start).reshape(-1)
         nll = _fused_vp_nll(h2, table, local_t, axis_name, not vma_active)
         return jnp.mean(nll)

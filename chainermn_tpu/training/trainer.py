@@ -13,6 +13,7 @@ from __future__ import annotations
 import time
 from typing import Any, Callable, Dict, Optional
 
+from ..observability import trace as _trace
 from .triggers import get_trigger
 
 PRIORITY_EDITOR = 300   # mutate trainer.observation (aggregators)
@@ -72,6 +73,12 @@ class Trainer:
         # this so a slow-but-progressing extension pass is not mistaken for
         # a hang — only one stuck unit can exceed the timeout.
         self.last_progress: Optional[float] = None
+        # Name of the last COMPLETED unit ("update" or "extension:<name>")
+        # — the Watchdog includes it in stall reports, and the step-time
+        # breakdown reads last_extension_time (the previous iteration's
+        # whole extension pass, seconds).
+        self.last_phase: Optional[str] = None
+        self.last_extension_time: Optional[float] = None
 
     # ---- passthroughs the extensions read ----
     @property
@@ -124,21 +131,31 @@ class Trainer:
         for e in self._extensions.values():
             if hasattr(e.extension, "initialize"):
                 e.extension.initialize(self)
+        tracer = _trace.get_tracer()
         try:
             while not self._stopped():
-                self.observation = self.updater.update()
-                self.last_progress = time.monotonic()
-                for e in sorted(self._extensions.values(),
-                                key=lambda e: -e.priority):
-                    # Extensions with an ``observe`` hook see EVERY iteration
-                    # (e.g. LogReport folding per-step stats into its means);
-                    # ``__call__`` still fires only on the trigger — the same
-                    # split Chainer's reporter/summary machinery provided [uv].
-                    if hasattr(e.extension, "observe"):
-                        e.extension.observe(self)
-                    if e.trigger(self):
-                        e.extension(self)
+                with tracer.span("step", cat="step",
+                                 iteration=self.iteration + 1):
+                    self.observation = self.updater.update()
                     self.last_progress = time.monotonic()
+                    self.last_phase = "update"
+                    t_ext = time.perf_counter()
+                    with tracer.span("step/extensions", cat="phase"):
+                        for e in sorted(self._extensions.values(),
+                                        key=lambda e: -e.priority):
+                            # Extensions with an ``observe`` hook see EVERY
+                            # iteration (e.g. LogReport folding per-step stats
+                            # into its means); ``__call__`` still fires only on
+                            # the trigger — the same split Chainer's reporter/
+                            # summary machinery provided [uv].
+                            with tracer.span(f"ext/{e.name}", cat="extension"):
+                                if hasattr(e.extension, "observe"):
+                                    e.extension.observe(self)
+                                if e.trigger(self):
+                                    e.extension(self)
+                            self.last_progress = time.monotonic()
+                            self.last_phase = f"extension:{e.name}"
+                    self.last_extension_time = time.perf_counter() - t_ext
         except BaseException:
             # Liveness monitors (Watchdog) MUST stop on the exception path —
             # a still-armed watchdog would os._exit a process that is busy

@@ -11,10 +11,14 @@ while the host prepares the next batch.
 
 from __future__ import annotations
 
+import time
 from typing import Any, Callable, Dict, Optional
 
 import jax
 import numpy as np
+
+from ..observability import trace as _trace
+from ..observability.comm import get_accountant as _get_accountant
 
 
 
@@ -45,6 +49,8 @@ class StandardUpdater:
         self.converter = converter
         self.shard = shard
         self.iteration = 0
+        self.phase_times: Optional[Dict[str, float]] = None
+        self.last_batch_size: Optional[int] = None
         if shard:
             # Resolve mesh + sharding ONCE: rebuilding them per step would
             # put host-side Mesh construction on the hot path.
@@ -70,12 +76,30 @@ class StandardUpdater:
         return getattr(self.iterator, "epoch_detail", float(self.epoch))
 
     def update(self) -> Dict[str, Any]:
-        batch = self.iterator.next()
-        arrays = self.converter(batch)
-        if self.shard:
-            arrays = jax.tree_util.tree_map(
-                lambda x: jax.device_put(x, self._batch_sharding), arrays)
-        self.state, observation = self.step_fn(self.state, arrays)
+        # Step-time breakdown: the data phase (host batch assembly +
+        # device upload) vs the compute phase (the jitted step call —
+        # asynchronous dispatch, so the on-device tail surfaces at the
+        # next host sync).  ``phase_times`` feeds
+        # ``observability.StepBreakdownReport``; spans land on the trace
+        # timeline; the comm accountant's step capture attributes the
+        # step program's collectives to this iteration.
+        tracer = _trace.get_tracer()
+        t0 = time.perf_counter()
+        with tracer.span("step/data", cat="phase"):
+            batch = self.iterator.next()
+            arrays = self.converter(batch)
+            if self.shard:
+                arrays = jax.tree_util.tree_map(
+                    lambda x: jax.device_put(x, self._batch_sharding), arrays)
+        t1 = time.perf_counter()
+        with _get_accountant().step("updater/step_fn"):
+            with tracer.span("step/compute", cat="phase"):
+                self.state, observation = self.step_fn(self.state, arrays)
+        t2 = time.perf_counter()
+        self.phase_times = {"data": t1 - t0, "compute": t2 - t1}
+        leaves = jax.tree_util.tree_leaves(arrays)
+        if leaves and getattr(leaves[0], "shape", None):
+            self.last_batch_size = int(leaves[0].shape[0])
         self.iteration += 1
         return dict(observation)
 

@@ -49,10 +49,10 @@ def main():
     import jax
     import numpy as np
     import optax
-    from jax import shard_map
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     import chainermn_tpu as mn
+    from chainermn_tpu._compat import shard_map
     from chainermn_tpu.parallel import (
         init_tp_transformer_lm, sp_transformer_lm_loss)
 

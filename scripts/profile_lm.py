@@ -58,6 +58,19 @@ def timeit(fn, *args, steps=STEPS, scalarize=lambda out: out):
 
 
 def main():
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        description="transformer-LM train-step component breakdown")
+    parser.add_argument("--trace-out", default=None,
+                        help="enable the observability tracer; write a "
+                             "Chrome-trace/Perfetto JSON here")
+    args = parser.parse_args()
+    obs = None
+    if args.trace_out:
+        from chainermn_tpu import observability as obs
+        obs.enable()
+
     dev = jax.devices()[0]
     report = {"device": dev.device_kind, "config": f"d{D} L{L} h{H} S{S} "
               f"V{VOCAB} b{B} bf16"}
@@ -204,6 +217,13 @@ def main():
     for k_ in list(report):
         if isinstance(report[k_], float):
             report[k_] = round(report[k_], 2)
+    if obs is not None:
+        for k_, v in report.items():
+            if isinstance(v, (int, float)):
+                obs.set_gauge(f"profile_lm/{k_}", float(v))
+        obs.export_chrome_trace(args.trace_out)
+        print(f"profile_lm: trace written to {args.trace_out}",
+              file=sys.stderr)
     print(json.dumps(report, indent=2))
 
 

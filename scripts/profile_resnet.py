@@ -39,22 +39,39 @@ def chain_step(step_fn, variables, opt_state, batch):
 
 
 def bench(tag, fn, args, flops=None):
-    out = fn(*args)
-    float(out)
-    best = 1e9
-    for _ in range(3):
-        t0 = time.perf_counter()
-        float(fn(*args))
-        best = min(best, (time.perf_counter() - t0 - 0.1) / N)
+    from chainermn_tpu.observability import set_gauge, span
+
+    with span(f"profile/{tag}", cat="bench"):  # no-op unless tracing on
+        out = fn(*args)
+        float(out)
+        best = 1e9
+        for _ in range(3):
+            t0 = time.perf_counter()
+            float(fn(*args))
+            best = min(best, (time.perf_counter() - t0 - 0.1) / N)
     ms = best * 1e3
     line = {"ms": round(ms, 3)}
     if flops:
         line["mfu"] = round(flops / best / PEAK, 3)
+    set_gauge(f"profile_resnet/{tag}_ms", ms)
     print(f"{tag}: {json.dumps(line)}", flush=True)
     return ms
 
 
 def main():
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        description="ResNet-50 step-time component breakdown")
+    parser.add_argument("--trace-out", default=None,
+                        help="enable the observability tracer; write a "
+                             "Chrome-trace/Perfetto JSON here")
+    args = parser.parse_args()
+    obs = None
+    if args.trace_out:
+        from chainermn_tpu import observability as obs
+        obs.enable()
+
     comm = mn.create_communicator("xla")
     mesh = comm.mesh
     model = ARCHS["resnet50"](stem_strides=2)
@@ -142,6 +159,11 @@ def main():
     s2d_flops = 2 * B * 56 * 56 * 64 * 4 * 48
     bench("conv_2x2_48ch_fwd(s2d-like)", conv_chain(stem48, v48, x48),
           (v48, x48), s2d_flops)
+
+    if obs is not None:
+        obs.export_chrome_trace(args.trace_out)
+        print(f"profile_resnet: trace written to {args.trace_out}",
+              flush=True)
 
 
 if __name__ == "__main__":

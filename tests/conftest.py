@@ -17,6 +17,12 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# Importing the package here (before any test module loads) installs the
+# jax version-compat shims (chainermn_tpu/_compat.py: `jax.shard_map`,
+# `jax.lax.axis_size` on old jax), so test modules written against new
+# JAX (`from jax import shard_map`) collect on the container's floor.
+import chainermn_tpu  # noqa: E402,F401
+
 import pytest  # noqa: E402
 
 

@@ -113,3 +113,19 @@ def test_rows_not_dividing_8_falls_back():
         np.asarray(jax.lax.dynamic_update_slice_in_dim(kc, kn, 6, 1)))
     with pytest.raises(ValueError, match="rows dividing"):
         cache_append(kc, kc, kn, kn, 6, axis=1, impl="pallas")
+
+
+def test_pallas_on_non_tpu_backend_raises_descriptive_error():
+    # A VALID envelope forced onto compiled Pallas off-chip must fail at
+    # dispatch with an actionable message, not deep in Mosaic lowering.
+    kc = jnp.zeros((2, 32, 16))
+    kn = jnp.ones((2, 1, 16))
+    with pytest.raises(ValueError, match="requires a TPU backend"):
+        cache_append(kc, kc, kn, kn, 6, axis=1, impl="pallas",
+                     interpret=False)
+    # interpret mode stays available off-chip
+    got, _ = cache_append(kc, kc, kn, kn, 6, axis=1, impl="pallas",
+                          interpret=True)
+    np.testing.assert_array_equal(
+        np.asarray(got),
+        np.asarray(jax.lax.dynamic_update_slice_in_dim(kc, kn, 6, 1)))
