@@ -798,6 +798,12 @@ def main():
                              "Chrome-trace/Perfetto JSON here (re-exported "
                              "after every section, so a killed run still "
                              "leaves a loadable artifact)")
+    parser.add_argument("--json-out", default=None,
+                        help="write the full result dict (section -> stats, "
+                             "the BENCH_*.json 'parsed' shape) to this file, "
+                             "atomically re-written after every section — "
+                             "the perf-trajectory input that "
+                             "scripts/check_perf_regression.py diffs")
     args = parser.parse_args()
 
     if args.scaling_worker is not None:
@@ -1062,6 +1068,13 @@ def main():
         result["wall_clock_s"] = round(time.time() - t_start, 1)
         print(json.dumps(result), flush=True)
         print(compact_line(), flush=True)
+        if args.json_out:
+            # atomic re-write per section: a killed run leaves the last
+            # COMPLETE result file, never a torn one
+            tmp = f"{args.json_out}.tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump(result, f, indent=1)
+            os.replace(tmp, args.json_out)
         if obs is not None:
             if section:
                 obs.instant(f"section/{section}", cat="bench")

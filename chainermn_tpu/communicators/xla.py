@@ -60,6 +60,11 @@ class XlaCommunicator(CommunicatorBase):
         self._progs: Dict[Any, Callable] = {}
         self._obj_mailbox: List[bytes] = []
         self._obj_seq: Dict[Any, int] = {}
+        # Sticky capability flag: True once the backend has proven it
+        # cannot run multiprocess computations (CPU backend).  The
+        # object-lane collectives then go straight to the KV fallback
+        # instead of re-running a failing multihost attempt per call.
+        self._mp_compute_off = False
 
     # ---- topology ----
     @property
@@ -280,35 +285,113 @@ class XlaCommunicator(CommunicatorBase):
                 "chainermn_tpu.init_distributed(coordinator_address=...) first")
         return client
 
+    def _kv_exchange_obj(self, tag: str, payload: Optional[bytes],
+                         src_procs: Optional[List[int]] = None
+                         ) -> Dict[int, bytes]:
+        """Generic object exchange over the jax.distributed KV store: each
+        process in ``src_procs`` (default: all) publishes ``payload``
+        under a fresh generation key; every process reads every
+        publisher's entry.  The fallback transport for backends whose
+        compute fabric cannot run multiprocess programs (this container's
+        CPU backend: ``multihost_utils`` collectives raise
+        INVALID_ARGUMENT) — the KV store is plain gRPC to the
+        coordinator, always available once jax.distributed is up."""
+        me = jax.process_index()
+        if src_procs is None:
+            src_procs = list(range(jax.process_count()))
+        gen = self._obj_seq.setdefault(("kv_exchange", tag), 0)
+        self._obj_seq[("kv_exchange", tag)] = gen + 1
+        client = self._kv_client()
+        if me in src_procs:
+            client.key_value_set_bytes(
+                f"chainermn_tpu_xchg/{tag}/{gen}/{me}", payload or b"")
+            # GC: these exchanges are collective calls made in the same
+            # order by every process, so by the time ANY process publishes
+            # generation g every process has finished READING g-2 (it
+            # published g-1, which required completing g-2) — our own g-2
+            # key is dead.  Without this the per-iteration
+            # ObservationAggregator would grow the coordinator's KV store
+            # without bound.
+            if gen >= 2:
+                try:
+                    client.key_value_delete(
+                        f"chainermn_tpu_xchg/{tag}/{gen - 2}/{me}")
+                except Exception:
+                    pass  # older jaxlib without delete: leak, don't fail
+        return {
+            p: client.blocking_key_value_get_bytes(
+                f"chainermn_tpu_xchg/{tag}/{gen}/{p}", 300_000)
+            for p in src_procs
+        }
+
+    def _mp_compute_unavailable(self, e: Exception) -> bool:
+        """True for the DETERMINISTIC backend-capability error ("…aren't
+        implemented on the CPU backend") — identical on every process and
+        every call, so all ranks switch to the KV fallback in lockstep.
+        Transient runtime errors (network blip, preemption) do NOT match
+        and propagate: a per-call fallback on those could split-brain the
+        transport (some ranks on the KV lane, some not) and desync the
+        generation counters."""
+        if "implemented" in str(e).lower():
+            self._mp_compute_off = True
+            return True
+        return False
+
     def bcast_obj(self, obj: Any, root: int = 0) -> Any:
         if self._multiprocess():
-            from jax.experimental import multihost_utils
             root_proc = self._devices[root].process_index
             is_src = jax.process_index() == root_proc
-            payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
-            n = int(multihost_utils.broadcast_one_to_all(
-                np.asarray(payload.size, np.int64), is_source=is_src))
-            buf = payload if is_src else np.zeros(n, np.uint8)
-            out = multihost_utils.broadcast_one_to_all(buf, is_source=is_src)
-            return pickle.loads(np.asarray(out).tobytes())
+            if not self._mp_compute_off:
+                try:
+                    from jax.experimental import multihost_utils
+                    payload = np.frombuffer(pickle.dumps(obj),
+                                            dtype=np.uint8)
+                    n = int(multihost_utils.broadcast_one_to_all(
+                        np.asarray(payload.size, np.int64),
+                        is_source=is_src))
+                    buf = payload if is_src else np.zeros(n, np.uint8)
+                    out = multihost_utils.broadcast_one_to_all(
+                        buf, is_source=is_src)
+                    return pickle.loads(np.asarray(out).tobytes())
+                except jax.errors.JaxRuntimeError as e:
+                    if not self._mp_compute_unavailable(e):
+                        raise
+            got = self._kv_exchange_obj(
+                "bcast", pickle.dumps(obj) if is_src else None,
+                src_procs=[root_proc])
+            return pickle.loads(got[root_proc])
         return pickle.loads(pickle.dumps(obj))
 
     def gather_obj(self, obj: Any, root: int = 0) -> Optional[List[Any]]:
         if self._multiprocess():
-            # Variable-length payloads: gather lengths first (fixed shape),
-            # pad to the max, then trim per entry.
-            from jax.experimental import multihost_utils
-            payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
-            lengths = multihost_utils.process_allgather(
-                np.asarray([payload.size], np.int64))
-            lengths = np.asarray(lengths).reshape(-1)
-            buf = np.zeros(int(lengths.max()), np.uint8)
-            buf[: payload.size] = payload
-            stacked = np.asarray(multihost_utils.process_allgather(buf))
-            per_proc = [
-                pickle.loads(stacked[p, : int(lengths[p])].tobytes())
-                for p in range(stacked.shape[0])
-            ]
+            per_proc = None
+            if not self._mp_compute_off:
+                try:
+                    # Variable-length payloads: gather lengths first
+                    # (fixed shape), pad to the max, then trim per entry.
+                    from jax.experimental import multihost_utils
+                    payload = np.frombuffer(pickle.dumps(obj),
+                                            dtype=np.uint8)
+                    lengths = multihost_utils.process_allgather(
+                        np.asarray([payload.size], np.int64))
+                    lengths = np.asarray(lengths).reshape(-1)
+                    buf = np.zeros(int(lengths.max()), np.uint8)
+                    buf[: payload.size] = payload
+                    stacked = np.asarray(
+                        multihost_utils.process_allgather(buf))
+                    per_proc = [
+                        pickle.loads(stacked[p, : int(lengths[p])].tobytes())
+                        for p in range(stacked.shape[0])
+                    ]
+                except jax.errors.JaxRuntimeError as e:
+                    if not self._mp_compute_unavailable(e):
+                        raise
+            if per_proc is None:
+                # CPU backend: ride the KV-store lane instead (see
+                # _kv_exchange_obj) — same all-processes-participate
+                # contract, so the fallback is collective-safe
+                got = self._kv_exchange_obj("gather", pickle.dumps(obj))
+                per_proc = [pickle.loads(got[p]) for p in sorted(got)]
             # one entry per RANK: each rank maps to its owning host's object
             return [per_proc[self._devices[r].process_index] for r in range(self.size)]
         return [pickle.loads(pickle.dumps(obj)) for _ in range(self.size)]

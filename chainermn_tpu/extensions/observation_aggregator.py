@@ -19,17 +19,48 @@ import numpy as np
 from ..communicators.base import CommunicatorBase
 
 
+def _as_numeric(v) -> "np.ndarray | None":
+    """float64 view of ``v``, or None when it is not numeric (strings,
+    dicts, arbitrary objects riding the observation)."""
+    try:
+        a = np.asarray(v, dtype=np.float64)
+    except (TypeError, ValueError):
+        return None
+    if a.dtype == object:
+        return None
+    return a
+
+
 def aggregate_observations(observation: Dict[str, Any],
                            comm: CommunicatorBase) -> Dict[str, Any]:
-    """Return the across-rank mean of each entry of ``observation``."""
+    """Return the across-rank mean of each entry of ``observation``.
+
+    Non-numeric entries (status strings, config echoes — anything
+    ``float64`` cannot hold) are passed through from the first rank that
+    reported them instead of crashing the whole aggregation; numeric
+    entries whose shapes disagree across ranks raise a ``ValueError``
+    that NAMES the offending key (a silent broadcast-mean over mismatched
+    shapes would log garbage as if it were a metric).
+    """
     gathered = comm.allgather_obj(observation)
     keys: list = []
     for g in gathered:  # union, so metrics reported by only some ranks survive
         keys.extend(k for k in g if k not in keys)
     out: Dict[str, Any] = {}
     for key in keys:
-        vals = [np.asarray(g[key], dtype=np.float64) for g in gathered
-                if key in g]
+        raw = [g[key] for g in gathered if key in g]
+        vals = [_as_numeric(v) for v in raw]
+        if any(v is None for v in vals):
+            # non-numeric on at least one rank: rank-0's (first reporting
+            # rank's) value wins, unaveraged
+            out[key] = raw[0]
+            continue
+        shapes = {v.shape for v in vals}
+        if len(shapes) > 1:
+            raise ValueError(
+                f"observation key {key!r} has mismatched shapes across "
+                f"ranks: {sorted(shapes)} — ranks must report the same "
+                f"shape (or rename per-rank variants)")
         out[key] = (np.mean(vals, axis=0) if vals[0].ndim
                     else float(np.mean(vals)))
     return out

@@ -52,6 +52,17 @@ class Watchdog:
     collective).  The timer arms at the FIRST completed unit of work and
     disarms at ``finalize`` (and on the trainer's exception path) — setup
     and the first step's arbitrarily-long XLA compile cannot false-trigger.
+
+    Evidence flush (ISSUE 2): before ``action`` runs, the watchdog
+    best-effort dumps the stall evidence to ``dump_dir`` (default: the
+    trainer's ``out`` directory) — a final trace export
+    (``watchdog_trace.json``, rank-sharded when ``rank`` is given) and a
+    ``watchdog_health.json`` :func:`observability.export.health_snapshot`
+    carrying the comm ledger, span summary, and any :class:`HealthMonitor`
+    findings.  The dump runs in a side thread bounded by
+    ``flush_timeout`` seconds, so a wedged filesystem cannot turn the
+    abort path into a second hang; whatever was written survives the
+    ``os._exit``.
     """
 
     trigger = (1, "iteration")
@@ -61,12 +72,19 @@ class Watchdog:
 
     def __init__(self, timeout: float = 600.0,
                  action: Optional[Callable[[float, float], None]] = None,
-                 poll_interval: Optional[float] = None):
+                 poll_interval: Optional[float] = None,
+                 dump_dir: Optional[str] = None,
+                 monitor=None, rank: Optional[int] = None,
+                 flush_timeout: float = 10.0):
         if timeout <= 0:
             raise ValueError(f"timeout must be positive, got {timeout}")
         self.timeout = float(timeout)
         self.action = action or _default_abort
         self.poll_interval = poll_interval or max(self.timeout / 4, 0.05)
+        self.dump_dir = dump_dir
+        self.monitor = monitor
+        self.rank = rank
+        self.flush_timeout = float(flush_timeout)
         self._last = None
         self._trainer = None
         self._stop = threading.Event()
@@ -109,6 +127,66 @@ class Watchdog:
         beats = [b for b in beats if b is not None]
         return max(beats) if beats else None
 
+    def _dump_evidence(self, gap: float) -> None:
+        """Write the stall evidence (trace flush + health snapshot) to
+        disk — runs on a side thread, bounded by ``flush_timeout``."""
+        import json
+
+        from ..observability import export as _export
+        from ..observability import trace as _trace
+
+        out = self.dump_dir or getattr(self._trainer, "out", None)
+        if out is None:
+            print("[chainermn_tpu watchdog] no dump_dir/trainer.out — "
+                  "skipping evidence files", file=sys.stderr, flush=True)
+            return
+        os.makedirs(out, exist_ok=True)
+        snap = _export.health_snapshot(self._trainer, monitor=self.monitor)
+        snap["watchdog"] = {"gap_s": round(gap, 1),
+                            "timeout_s": self.timeout,
+                            "last_phase": getattr(self._trainer,
+                                                  "last_phase", None)}
+        health_path = os.path.join(out, "watchdog_health.json")
+        if self.rank is not None:
+            # rank-sharded like the trace: a gang stall fires every
+            # rank's watchdog near-simultaneously into the SAME dump_dir,
+            # and last-writer-wins would erase exactly the per-rank
+            # attribution this dump exists for
+            from ..observability.aggregate import shard_path
+            health_path = shard_path(health_path, self.rank)
+        tmp = f"{health_path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(snap, f, indent=2, default=str)
+        os.replace(tmp, health_path)
+        wrote = [health_path]
+        tr = _trace.get_tracer()
+        if tr.enabled:
+            trace_path = os.path.join(out, "watchdog_trace.json")
+            tr.export_chrome_trace(trace_path, rank=self.rank)
+            wrote.append(trace_path if self.rank is None else
+                         "rank-sharded " + trace_path)
+        print(f"[chainermn_tpu watchdog] stall evidence written: "
+              f"{', '.join(wrote)}", file=sys.stderr, flush=True)
+
+    def _flush_before_abort(self, gap: float) -> None:
+        """Best-effort, time-bounded evidence dump; never raises — the
+        abort must proceed even if the dump wedges or explodes."""
+        def run():
+            try:
+                self._dump_evidence(gap)
+            except Exception as e:
+                print(f"[chainermn_tpu watchdog] evidence dump failed: "
+                      f"{e!r}", file=sys.stderr, flush=True)
+
+        t = threading.Thread(target=run, name="chainermn-tpu-watchdog-dump",
+                             daemon=True)
+        t.start()
+        t.join(timeout=self.flush_timeout)
+        if t.is_alive():
+            print(f"[chainermn_tpu watchdog] evidence dump still running "
+                  f"after {self.flush_timeout:.0f}s — aborting anyway",
+                  file=sys.stderr, flush=True)
+
     def _watch(self) -> None:
         while not self._stop.wait(self.poll_interval):
             last = self._heartbeat()
@@ -126,6 +204,9 @@ class Watchdog:
                           f"phase: {phase} at iteration "
                           f"{getattr(self._trainer, 'iteration', '?')}",
                           file=sys.stderr, flush=True)
+                # Evidence first (bounded): the default action os._exits,
+                # and the trace buffer/comm ledger live only in memory.
+                self._flush_before_abort(gap)
                 self.action(gap, self.timeout)
                 return
 

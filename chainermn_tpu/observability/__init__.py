@@ -11,6 +11,17 @@ Three pieces (docs/OBSERVABILITY.md is the user guide):
   through the trainer observation path so the values are rank-aggregated
   like any other metric.
 
+Fleet layer (ISSUE 2):
+
+* :mod:`.aggregate` — per-rank trace shard merge (one Perfetto lane per
+  rank) and the cross-rank skew report naming the straggler rank.
+* :mod:`.anomaly` — rolling-window detectors (step-time spikes, loss
+  NaN/divergence, comm-bytes drift, MFU drop) behind the
+  :class:`HealthMonitor` trainer extension.
+* :mod:`.export` — Prometheus textfile + versioned JSONL metrics stream
+  (:class:`MetricsReport`) and the :func:`health_snapshot` dict the
+  Watchdog dumps before aborting a stalled gang.
+
 Quick start::
 
     import chainermn_tpu as mn
@@ -44,6 +55,30 @@ from .metrics import (  # noqa: F401
     StepBreakdownReport,
     hbm_bw_for,
     peak_flops_for,
+)
+from .aggregate import (  # noqa: F401
+    cross_rank_report,
+    find_shards,
+    local_rank_summary,
+    merge_trace_shards,
+    shard_path,
+)
+from .anomaly import (  # noqa: F401
+    CommBytesDriftDetector,
+    HealthMonitor,
+    LossAnomalyDetector,
+    MFUDropDetector,
+    StepTimeSpikeDetector,
+    default_detectors,
+)
+from .export import (  # noqa: F401
+    SCHEMA as METRICS_SCHEMA,
+    MetricsReport,
+    MetricsWriter,
+    health_snapshot,
+    prometheus_text,
+    read_metrics_jsonl,
+    write_prometheus_textfile,
 )
 
 
@@ -80,4 +115,23 @@ __all__ = [
     "StepBreakdownReport",
     "peak_flops_for",
     "hbm_bw_for",
+    # fleet layer (ISSUE 2)
+    "shard_path",
+    "find_shards",
+    "merge_trace_shards",
+    "local_rank_summary",
+    "cross_rank_report",
+    "HealthMonitor",
+    "StepTimeSpikeDetector",
+    "LossAnomalyDetector",
+    "CommBytesDriftDetector",
+    "MFUDropDetector",
+    "default_detectors",
+    "METRICS_SCHEMA",
+    "MetricsWriter",
+    "MetricsReport",
+    "read_metrics_jsonl",
+    "health_snapshot",
+    "prometheus_text",
+    "write_prometheus_textfile",
 ]

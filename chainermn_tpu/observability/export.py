@@ -1,0 +1,312 @@
+"""Machine-readable metrics export: Prometheus textfile + JSONL stream.
+
+The ROADMAP north star is a production service, and production gates on
+what machines can scrape — not on a Perfetto file a human eyeballs.  Two
+export faces, one source of truth (the tracer + comm accountant + the
+trainer's observation path):
+
+* **Prometheus textfile** (:func:`write_prometheus_textfile`) — the
+  node-exporter textfile-collector contract: counters as ``_total``,
+  gauges as-is, all under the ``chainermn_tpu_`` namespace, written
+  atomically so a scrape never sees a torn file.
+* **JSONL metrics stream** (:class:`MetricsWriter` /
+  :class:`MetricsReport`) — one JSON object per line, append-only, each
+  record stamped with the versioned schema id (``SCHEMA``), a kind, a
+  wall-clock timestamp, and (under multi-controller) the writing rank.
+  Append-only + per-line flush means a killed run keeps every record up
+  to the kill, and ``scripts/check_perf_regression.py`` can diff two
+  streams without any end-of-run finalization having happened.
+
+:func:`health_snapshot` assembles the "what was this process doing"
+dict — counters, gauges, span summary, comm ledger, last step report,
+anomaly findings — that the Watchdog dumps before aborting a stalled
+gang and that the train CLI writes at clean exit.
+
+Schema evolution rule: bump :data:`SCHEMA` whenever a consumer-visible
+field changes meaning; readers (``read_metrics_jsonl``) reject streams
+whose major schema id they do not know, loudly, instead of mis-parsing.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import time
+from typing import Any, Dict, IO, List, Optional
+
+from . import trace
+from .comm import get_accountant
+
+#: Versioned schema id stamped on every JSONL record and snapshot.
+SCHEMA = "chainermn_tpu.metrics.v1"
+
+_PROM_BAD = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _atomic_write_text(path: str, text: str) -> None:
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write(text)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def _prom_name(name: str) -> str:
+    return "chainermn_tpu_" + _PROM_BAD.sub("_", name).strip("_")
+
+
+def prometheus_text(extra_gauges: Optional[Dict[str, float]] = None) -> str:
+    """Render the tracer's counters/gauges + the comm ledger in the
+    Prometheus text exposition format (version 0.0.4)."""
+    tr = trace.get_tracer()
+    lines: List[str] = []
+
+    def esc(v: str) -> str:
+        return str(v).replace("\\", "\\\\").replace('"', '\\"')
+
+    def emit(name: str, kind: str, value: float,
+             labels: Optional[Dict[str, str]] = None) -> None:
+        lines.append(f"# TYPE {name} {kind}")
+        lab = ""
+        if labels:
+            inner = ",".join(f'{k}="{esc(v)}"'
+                             for k, v in sorted(labels.items()))
+            lab = "{" + inner + "}"
+        lines.append(f"{name}{lab} {float(value)}")
+
+    for name, total in sorted(tr.counters().items()):
+        emit(_prom_name(name) + "_total", "counter", total)
+    for name, value in sorted(tr.gauges().items()):
+        emit(_prom_name(name), "gauge", value)
+    for name, value in sorted((extra_gauges or {}).items()):
+        emit(_prom_name(name), "gauge", value)
+    spans = tr.summary()["spans"]
+    if spans:
+        for family, field, scale in (
+                ("chainermn_tpu_span_seconds_total", "total_ms", 1e-3),
+                ("chainermn_tpu_span_count_total", "count", 1.0)):
+            lines.append(f"# TYPE {family} counter")
+            for name, row in sorted(spans.items()):
+                lines.append(f'{family}{{name="{esc(name)}"}} '
+                             f"{float(row[field]) * scale}")
+    rep = get_accountant().report()
+    if rep["per_op"]:
+        # one TYPE line per family, then every labeled sample
+        for family, field in (("chainermn_tpu_comm_bytes_total", "bytes"),
+                              ("chainermn_tpu_comm_calls_total", "calls"),
+                              ("chainermn_tpu_comm_host_seconds_total",
+                               "host_time_s")):
+            lines.append(f"# TYPE {family} counter")
+            for key, row in sorted(rep["per_op"].items()):
+                op, _, axis = key.partition("@")
+                lab = f'{{axis="{esc(axis)}",op="{esc(op)}"}}'
+                lines.append(
+                    f"{family}{lab} {float(row.get(field, 0.0))}")
+    return "\n".join(lines) + "\n"
+
+
+def write_prometheus_textfile(path: str,
+                              extra_gauges: Optional[Dict[str, float]]
+                              = None) -> str:
+    """Atomically write :func:`prometheus_text` to ``path``; returns the
+    rendered text."""
+    text = prometheus_text(extra_gauges)
+    _atomic_write_text(path, text)
+    return text
+
+
+def _numeric(v) -> Optional[float]:
+    """Host-side numeric or None — deliberately does NOT call float() on
+    device arrays: an exporter must never force a device sync."""
+    if isinstance(v, bool):
+        return float(v)
+    if isinstance(v, (int, float)):
+        return float(v)
+    # 0-d numpy scalars (np.float32(…)) are host-side and cheap
+    item = getattr(v, "item", None)
+    if item is not None and getattr(v, "shape", None) == () \
+            and type(v).__module__.startswith("numpy"):
+        try:
+            return float(item())
+        except (TypeError, ValueError):
+            return None
+    return None
+
+
+class MetricsWriter:
+    """Append-only JSONL stream with a versioned schema stamp per record.
+
+    One writer per process; under multi-controller each rank writes its
+    own file (``shard_path``-style suffix chosen by the caller) or passes
+    ``rank`` so records are attributable after a cat-merge.  Lines are
+    flushed as written: a SIGKILL loses at most the current line, never
+    the stream.
+    """
+
+    def __init__(self, path: str, rank: Optional[int] = None):
+        self.path = str(path)
+        self.rank = rank
+        d = os.path.dirname(os.path.abspath(self.path))
+        os.makedirs(d, exist_ok=True)
+        self._f: Optional[IO[str]] = open(self.path, "a")
+
+    def write(self, record: Dict[str, Any], kind: str = "step") -> Dict[str, Any]:
+        if self._f is None:
+            raise ValueError(f"MetricsWriter({self.path!r}) is closed")
+        rec = {"schema": SCHEMA, "kind": kind, "t": round(time.time(), 3)}
+        if self.rank is not None:
+            rec["rank"] = int(self.rank)
+        rec.update(record)
+        # the stream's stamps are authoritative: a payload carrying its
+        # own schema/kind (e.g. a skew report) keeps it under payload_*
+        if record.get("schema") not in (None, SCHEMA):
+            rec["payload_schema"] = record["schema"]
+        rec["schema"] = SCHEMA
+        rec["kind"] = kind
+        self._f.write(json.dumps(rec, sort_keys=True) + "\n")
+        self._f.flush()
+        return rec
+
+    def close(self) -> None:
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+
+
+def read_metrics_jsonl(path: str, strict: bool = True) -> List[Dict[str, Any]]:
+    """Parse a JSONL metrics stream, validating the schema stamp.
+
+    ``strict`` raises ``ValueError`` on a record with a missing/unknown
+    schema id (consumer contract: refuse to mis-parse); non-strict skips
+    such records.  A trailing torn line (killed writer) is always
+    tolerated.
+    """
+    records: List[Dict[str, Any]] = []
+    with open(path) as f:
+        lines = f.read().splitlines()
+    for i, line in enumerate(lines):
+        if not line.strip():
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            if i == len(lines) - 1:
+                continue  # torn final line from a killed writer
+            raise ValueError(f"{path}:{i + 1}: unparseable JSONL line")
+        schema = rec.get("schema")
+        if schema != SCHEMA:
+            if strict:
+                raise ValueError(
+                    f"{path}:{i + 1}: unknown metrics schema {schema!r} "
+                    f"(this reader speaks {SCHEMA!r})")
+            continue
+        records.append(rec)
+    return records
+
+
+def health_snapshot(trainer=None, monitor=None,
+                    extra: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    """One dict answering "what was this process doing": tracer summary,
+    comm ledger, last per-step comm report, trainer position, anomaly
+    findings.  Everything host-side; safe to call from the Watchdog's
+    abort path."""
+    tr = trace.get_tracer()
+    acct = get_accountant()
+    snap: Dict[str, Any] = {
+        "schema": SCHEMA,
+        "kind": "health_snapshot",
+        "t": round(time.time(), 3),
+        "tracing_enabled": tr.enabled,
+        "spans": tr.summary()["spans"],
+        "counters": tr.counters(),
+        "gauges": tr.gauges(),
+        "comm": acct.report(),
+        "last_step_comm": acct.last_step_report,
+    }
+    if trainer is not None:
+        snap["iteration"] = getattr(trainer, "iteration", None)
+        snap["last_phase"] = getattr(trainer, "last_phase", None)
+        snap["elapsed_time"] = getattr(trainer, "elapsed_time", None)
+    if monitor is not None and hasattr(monitor, "health"):
+        snap["anomalies"] = monitor.health()
+    if extra:
+        snap.update(extra)
+    return snap
+
+
+class MetricsReport:
+    """Trainer extension streaming per-iteration metrics to JSONL (and,
+    optionally, a Prometheus textfile refreshed every ``prom_every``
+    iterations).
+
+    Records carry every *host-side numeric* observation entry (device
+    scalars are skipped, not synced — add a LogReport/PrintReport if you
+    want forced readbacks), the step-time phases, and the per-step comm
+    report.  ``finalize`` appends a ``summary`` record with the full
+    :func:`health_snapshot` and writes the final textfile, so a clean
+    run's last line is always the roll-up.
+
+    Priority 330: after StepBreakdownReport (350) and HealthMonitor (340)
+    have produced their keys/findings, before the ObservationAggregator
+    (300) replaces local values with rank means — the stream records what
+    THIS rank saw, which is the whole point of a per-rank export.
+    """
+
+    trigger = (1, "iteration")
+    priority = 330
+
+    def __init__(self, path: str, every: int = 1,
+                 prometheus_path: Optional[str] = None,
+                 prom_every: int = 10, monitor=None,
+                 rank: Optional[int] = None):
+        self.writer = MetricsWriter(path, rank=rank)
+        self.every = max(int(every), 1)
+        self.prometheus_path = prometheus_path
+        self.prom_every = max(int(prom_every), 1)
+        self.monitor = monitor
+        self._trainer = None
+
+    def observe(self, trainer) -> None:
+        self._trainer = trainer
+        it = trainer.iteration
+        if it % self.every:
+            return
+        rec: Dict[str, Any] = {"iteration": it}
+        for key, val in trainer.observation.items():
+            num = _numeric(val)
+            if num is not None:
+                rec[key] = num
+        phases = getattr(trainer.updater, "phase_times", None)
+        if phases:
+            for phase, dt in phases.items():
+                rec.setdefault(f"time/{phase}", float(dt))
+        step_rep = get_accountant().last_step_report
+        if step_rep is not None:
+            rec.setdefault("comm/bytes", step_rep["bytes"])
+            rec.setdefault("comm/calls", step_rep["calls"])
+        self.writer.write(rec, kind="step")
+        if self.prometheus_path and it % self.prom_every == 0:
+            write_prometheus_textfile(self.prometheus_path)
+
+    def __call__(self, trainer) -> None:
+        pass
+
+    def finalize(self) -> None:
+        try:
+            self.writer.write(
+                health_snapshot(self._trainer, self.monitor),
+                kind="summary")
+            if self.prometheus_path:
+                write_prometheus_textfile(self.prometheus_path)
+        finally:
+            self.writer.close()
+
+    def state_dict(self) -> dict:
+        return {}
+
+    def load_state_dict(self, state: dict) -> None:
+        pass

@@ -253,27 +253,48 @@ class Tracer:
         return {"spans": spans, "counters": self.counters(),
                 "gauges": self.gauges(), "dropped_events": self._dropped}
 
-    def export_chrome_trace(self, path: str) -> Dict[str, Any]:
+    def export_chrome_trace(self, path: str,
+                            rank: Optional[int] = None) -> Dict[str, Any]:
         """Write the Chrome Trace Event JSON (loadable in Perfetto /
-        ``chrome://tracing``); returns the document."""
-        meta = [{"name": "process_name", "ph": "M", "pid": self._pid,
-                 "tid": 0, "args": {"name": "chainermn_tpu"}}]
+        ``chrome://tracing``); returns the document.
+
+        ``rank`` switches on the **rank-sharded mode** for multi-controller
+        jobs: the file goes to :func:`shard_path` (``trace.json`` →
+        ``trace.rank00003.json``), every event's ``pid`` is rewritten to
+        the rank (one Perfetto lane per rank after the merge), the process
+        lane is named ``rank N``, and the document carries a
+        ``metadata.rank`` stamp that ``observability.aggregate
+        .merge_trace_shards`` reads back.  Each shard is itself a valid
+        standalone trace.
+        """
+        pid = self._pid if rank is None else int(rank)
+        pname = "chainermn_tpu" if rank is None else f"rank {int(rank)}"
+        meta = [{"name": "process_name", "ph": "M", "pid": pid,
+                 "tid": 0, "args": {"name": pname}}]
         with self._lock:
             for ident, tid in sorted(self._tids.items(),
                                      key=lambda kv: kv[1]):
                 meta.append({"name": "thread_name", "ph": "M",
-                             "pid": self._pid, "tid": tid,
+                             "pid": pid, "tid": tid,
                              "args": {"name": f"thread-{tid}"
                                       if tid else "main"}})
-            events = meta + list(self._events)
+            events = meta + (
+                list(self._events) if rank is None
+                else [dict(ev, pid=pid) for ev in self._events])
             if self._dropped:
                 events.append({
                     "name": "trace/truncated", "cat": "tracer", "ph": "i",
-                    "s": "g", "ts": self._now_us(), "pid": self._pid,
+                    "s": "g", "ts": self._now_us(), "pid": pid,
                     "tid": 0,
                     "args": {"dropped_events": self._dropped,
                              "max_events": self.max_events}})
             doc = {"traceEvents": events, "displayTimeUnit": "ms"}
+            if rank is not None:
+                doc["metadata"] = {"rank": int(rank),
+                                   "host_pid": self._pid}
+        if rank is not None:
+            from .aggregate import shard_path
+            path = shard_path(path, rank)
         d = os.path.dirname(os.path.abspath(path))
         os.makedirs(d, exist_ok=True)
         tmp = path + ".tmp"
@@ -327,5 +348,6 @@ def set_gauge(name: str, value: float) -> None:
     _GLOBAL.set_gauge(name, value)
 
 
-def export_chrome_trace(path: str) -> Dict[str, Any]:
-    return _GLOBAL.export_chrome_trace(path)
+def export_chrome_trace(path: str,
+                        rank: Optional[int] = None) -> Dict[str, Any]:
+    return _GLOBAL.export_chrome_trace(path, rank=rank)

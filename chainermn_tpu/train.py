@@ -371,7 +371,17 @@ def main(argv=None) -> int:
     parser.add_argument("--out", default="result")
     parser.add_argument("--trace-out", default=None,
                         help="write a Chrome-trace/Perfetto JSON here "
-                             "(also enables tracing)")
+                             "(also enables tracing); under "
+                             "multi-controller each process writes a "
+                             "rank shard and process 0 merges them into "
+                             "this path (one Perfetto lane per rank)")
+    parser.add_argument("--metrics-out", default=None,
+                        help="append a versioned JSONL metrics stream "
+                             "here (also enables tracing); a Prometheus "
+                             "textfile lands next to it at <path>.prom "
+                             "and the cross-rank skew report is appended "
+                             "at exit")
+    parser.add_argument("--watchdog-timeout", type=float, default=1800.0)
     args = parser.parse_args(argv)
 
     if args.devices:
@@ -393,12 +403,16 @@ def main(argv=None) -> int:
     from .training.trainer import PRIORITY_EDITOR, Trainer
     from .training.updaters import StandardUpdater
 
-    if args.trace_out:
+    if args.trace_out or args.metrics_out:
         obs.enable()
 
     comm = create_communicator("xla")
     mesh = comm.mesh
     world = comm.size
+    # Rank-sharded artifact mode: one controller process per host means
+    # per-PROCESS shards; single-controller writes plain files.
+    multi = jax.process_count() > 1
+    rank = jax.process_index() if multi else None
     if args.batchsize % world:
         raise SystemExit(
             f"--batchsize {args.batchsize} must divide by the {world}-chip mesh")
@@ -433,13 +447,25 @@ def main(argv=None) -> int:
     trainer.extend(ObservationAggregator(comm), trigger=(1, "iteration"),
                    priority=PRIORITY_EDITOR)
     trainer.extend(obs.StepBreakdownReport(items_per_step=args.batchsize))
+    monitor = None
+    if args.trace_out or args.metrics_out:
+        monitor = obs.HealthMonitor()
+        trainer.extend(monitor)
+    metrics_path = None
+    if args.metrics_out:
+        metrics_path = (obs.shard_path(args.metrics_out, rank)
+                        if rank is not None else args.metrics_out)
+        trainer.extend(obs.MetricsReport(
+            metrics_path, prometheus_path=metrics_path + ".prom",
+            monitor=monitor, rank=rank))
     log = LogReport(trigger=(args.log_every, "iteration"))
     trainer.extend(log)
     trainer.extend(PrintReport(
         ["iteration", "main/loss", "main/accuracy", "time/data",
          "time/compute", "comm/bytes", "throughput/items_per_sec"],
         log, trigger=(args.log_every, "iteration")))
-    trainer.extend(Watchdog(timeout=1800.0))
+    trainer.extend(Watchdog(timeout=args.watchdog_timeout,
+                            dump_dir=args.out, monitor=monitor, rank=rank))
     trainer.run()
 
     final = log.log[-1] if log.log else {}
@@ -450,12 +476,33 @@ def main(argv=None) -> int:
         "final_accuracy": final.get("main/accuracy"),
     }
     if args.trace_out:
-        obs.export_chrome_trace(args.trace_out)
-        result["trace_out"] = args.trace_out
+        obs.export_chrome_trace(args.trace_out, rank=rank)
+        result["trace_out"] = (args.trace_out if rank is None
+                               else obs.shard_path(args.trace_out, rank))
         result["trace_events"] = len(obs.get_tracer().events())
         result["comm_totals"] = {
             k: {kk: vv for kk, vv in v.items() if kk != "host_time_s"}
             for k, v in obs.comm_report()["per_op"].items()}
+        if multi:
+            # barrier: every shard on disk before process 0 merges them
+            comm.allgather_obj("trace-exported")
+            if jax.process_index() == 0:
+                merged = obs.merge_trace_shards(
+                    args.trace_out, out_path=args.trace_out,
+                    expected_ranks=jax.process_count())
+                result["merged_trace"] = args.trace_out
+                result["merged_ranks"] = merged["metadata"]["merged_ranks"]
+    if args.trace_out or args.metrics_out:
+        # Cross-rank skew report: collective over the DCN object lane.
+        skew = obs.cross_rank_report(comm)
+        result["straggler_rank"] = skew["straggler_rank"]
+        result["step_time_skew"] = {
+            k: round(v, 6) for k, v in skew["step_time"].items()
+            if k != "per_rank"}
+        if metrics_path and (rank is None or rank == 0):
+            w = obs.MetricsWriter(metrics_path, rank=rank)
+            w.write(skew, kind="skew_report")
+            w.close()
     print(json.dumps(result))
     return 0
 

@@ -1,0 +1,240 @@
+#!/usr/bin/env python
+"""Perf regression gate: diff two metrics/bench files, exit nonzero on
+regression.
+
+The machine half of the observability story (docs/OBSERVABILITY.md): a
+CI job runs the bench (or a training smoke) twice — baseline artifact vs
+this commit — and this script decides, deterministically, whether the
+commit made things worse.  No JAX import, no framework import: the gate
+must run on any box that can read JSON.
+
+Accepted input shapes (auto-detected per file):
+
+* **bench result JSON** — the dict ``bench.py --json-out`` writes
+  (section → stats; also the ``BENCH_*.json`` driver artifact, whose
+  ``parsed`` field is unwrapped automatically);
+* **JSONL metrics stream** — the ``chainermn_tpu.metrics.v1`` stream
+  written by ``--metrics-out`` (train CLI / MetricsReport / profile
+  scripts).  Per-step records are averaged per key; profile/summary
+  records contribute their numeric fields directly.
+
+Metric direction is inferred from the key: names containing
+time/ms/seconds/latency/bytes/loss compare lower-is-better, everything
+else (ips, tokens/sec, mfu, efficiency, accuracy) higher-is-better.
+A metric regresses when it is worse than baseline by more than
+``--threshold`` (relative, default 5%).
+
+Exit codes: 0 = no regression, 1 = regression(s) found, 2 = inputs
+unusable (unreadable, or no comparable metrics).
+
+Usage::
+
+    python scripts/check_perf_regression.py baseline.json current.json
+    python scripts/check_perf_regression.py base_metrics.jsonl \
+        new_metrics.jsonl --threshold 0.1 --json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import re
+import sys
+from typing import Dict, Optional, Tuple
+
+METRICS_SCHEMA_PREFIX = "chainermn_tpu.metrics."
+
+#: Keys that are bookkeeping, not performance — never compared.
+#: straggler_rank is an IDENTITY (which rank was slowest), not a
+#: magnitude — comparing it numerically would flag a mere identity
+#: change as a regression.
+_SKIP = re.compile(
+    r"(^|/)(iteration|epoch|t|ts|rank|ranks|n|steps|reps|schema|kind|"
+    r"wall_clock_s|elapsed_time|host_physical_cores|n_params|n_records|"
+    r"batch|headline_batch|grad_bytes(_fp32)?|record|seed|"
+    r"straggler_rank|merged_ranks|expected_ranks)($|/)")
+
+#: Lower-is-better key fingerprints (everything else: higher is better).
+#: slowdown/imbalance/drift come from the skew report; anomaly counts and
+#: dropped-event tallies are failure tallies — more is worse.
+_LOWER = re.compile(
+    r"(time|_ms|ms_|/ms$|^ms$|latency|seconds|_s$|/s$|bytes|loss|"
+    r"step_ms|gap|slowdown|imbalance|drift|anomal|dropped|findings)",
+    re.IGNORECASE)
+
+
+def lower_is_better(key: str) -> bool:
+    return bool(_LOWER.search(key))
+
+
+def _flatten(obj, prefix: str, out: Dict[str, float]) -> None:
+    if isinstance(obj, dict):
+        for k, v in obj.items():
+            _flatten(v, f"{prefix}/{k}" if prefix else str(k), out)
+        return
+    if isinstance(obj, bool) or obj is None:
+        return
+    if isinstance(obj, (int, float)) and math.isfinite(float(obj)):
+        if not _SKIP.search(prefix):
+            out[prefix] = float(obj)
+
+
+def _load_jsonl(path: str) -> Optional[Dict[str, float]]:
+    """Parse a metrics JSONL stream into mean-per-key metrics, or None if
+    the file is not a recognizable stream."""
+    sums: Dict[str, float] = {}
+    counts: Dict[str, int] = {}
+    singles: Dict[str, float] = {}
+    n_records = 0
+    with open(path) as f:
+        lines = f.read().splitlines()
+    for i, line in enumerate(lines):
+        if not line.strip():
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            if i == len(lines) - 1:
+                continue  # torn final line from a killed writer
+            return None
+        if not isinstance(rec, dict):
+            return None
+        schema = rec.get("schema", "")
+        if not str(schema).startswith(METRICS_SCHEMA_PREFIX):
+            continue  # foreign record in the stream: skip, don't reject
+        n_records += 1
+        kind = rec.get("kind", "step")
+        flat: Dict[str, float] = {}
+        _flatten({k: v for k, v in rec.items()
+                  if k not in ("schema", "kind", "t", "rank")}, "", flat)
+        if kind == "step":
+            for k, v in flat.items():
+                sums[k] = sums.get(k, 0.0) + v
+                counts[k] = counts.get(k, 0) + 1
+        else:
+            # profile/summary/skew records: one-shot values, namespaced by
+            # kind so a summary counter cannot shadow a step mean
+            for k, v in flat.items():
+                singles[f"{kind}/{k}"] = v
+    if not n_records:
+        return None
+    metrics = {k: sums[k] / counts[k] for k in sums}
+    metrics.update(singles)
+    return metrics
+
+
+def _load_json(path: str) -> Optional[Dict[str, float]]:
+    with open(path) as f:
+        try:
+            doc = json.load(f)
+        except ValueError:
+            return None
+    if not isinstance(doc, dict):
+        return None
+    # BENCH_*.json driver artifact: the result line lives under "parsed"
+    if isinstance(doc.get("parsed"), dict):
+        doc = doc["parsed"]
+    out: Dict[str, float] = {}
+    _flatten(doc, "", out)
+    return out or None
+
+
+def load_metrics(path: str) -> Dict[str, float]:
+    metrics = _load_jsonl(path)
+    if metrics is None:
+        metrics = _load_json(path)
+    if metrics is None:
+        print(f"check_perf_regression: {path!r} is neither a bench result "
+              f"JSON nor a {METRICS_SCHEMA_PREFIX}* JSONL stream (exit 2)",
+              file=sys.stderr)
+        raise SystemExit(2)
+    return metrics
+
+
+def compare(base: Dict[str, float], cur: Dict[str, float],
+            threshold: float, keys=None
+            ) -> Tuple[list, list, list]:
+    """Returns (regressions, improvements, unchanged) rows:
+    ``(key, base, cur, rel_change, direction)`` with rel_change signed so
+    that POSITIVE means worse."""
+    common = sorted(set(base) & set(cur))
+    if keys:
+        common = [k for k in common if k in keys]
+    regressions, improvements, unchanged = [], [], []
+    for k in common:
+        b, c = base[k], cur[k]
+        if abs(b) < 1e-12:
+            continue  # no meaningful relative change from ~zero
+        lower = lower_is_better(k)
+        # signed "worseness": +x means x worse than baseline
+        worse = (c - b) / abs(b) if lower else (b - c) / abs(b)
+        row = (k, b, c, worse, "lower" if lower else "higher")
+        if worse > threshold:
+            regressions.append(row)
+        elif worse < -threshold:
+            improvements.append(row)
+        else:
+            unchanged.append(row)
+    return regressions, improvements, unchanged
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="diff two metrics/bench JSON files; exit 1 on "
+                    "regression")
+    parser.add_argument("baseline")
+    parser.add_argument("current")
+    parser.add_argument("--threshold", type=float, default=0.05,
+                        help="relative worsening that counts as a "
+                             "regression (default 0.05 = 5%%)")
+    parser.add_argument("--keys", default=None,
+                        help="comma-separated allowlist of metric keys "
+                             "(default: every key present in both files)")
+    parser.add_argument("--json", action="store_true",
+                        help="emit the verdict as one JSON object on "
+                             "stdout (for CI parsing)")
+    args = parser.parse_args(argv)
+
+    base = load_metrics(args.baseline)
+    cur = load_metrics(args.current)
+    keys = set(args.keys.split(",")) if args.keys else None
+    regressions, improvements, unchanged = compare(
+        base, cur, args.threshold, keys)
+    n_compared = len(regressions) + len(improvements) + len(unchanged)
+    if n_compared == 0:
+        print(f"check_perf_regression: no comparable metrics between "
+              f"{args.baseline!r} and {args.current!r} (exit 2)",
+              file=sys.stderr)
+        return 2
+
+    if args.json:
+        print(json.dumps({
+            "ok": not regressions,
+            "threshold": args.threshold,
+            "compared": n_compared,
+            "regressions": [
+                {"key": k, "baseline": b, "current": c,
+                 "worse_by": round(w, 4), "direction": d}
+                for k, b, c, w, d in regressions],
+            "improvements": [
+                {"key": k, "baseline": b, "current": c,
+                 "better_by": round(-w, 4), "direction": d}
+                for k, b, c, w, d in improvements],
+        }, sort_keys=True))
+    else:
+        for k, b, c, w, d in regressions:
+            print(f"REGRESSION {k}: {b:.6g} -> {c:.6g} "
+                  f"({w * 100:+.1f}% worse; {d} is better)")
+        for k, b, c, w, d in improvements:
+            print(f"improved   {k}: {b:.6g} -> {c:.6g} "
+                  f"({-w * 100:+.1f}% better)")
+        print(f"check_perf_regression: {n_compared} metrics compared, "
+              f"{len(regressions)} regression(s), "
+              f"{len(improvements)} improvement(s) "
+              f"[threshold {args.threshold * 100:.0f}%]")
+    return 1 if regressions else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -65,9 +65,13 @@ def main():
     parser.add_argument("--trace-out", default=None,
                         help="enable the observability tracer; write a "
                              "Chrome-trace/Perfetto JSON here")
+    parser.add_argument("--metrics-out", default=None,
+                        help="append the report as one record of the "
+                             "versioned JSONL metrics stream "
+                             "(check_perf_regression.py input)")
     args = parser.parse_args()
     obs = None
-    if args.trace_out:
+    if args.trace_out or args.metrics_out:
         from chainermn_tpu import observability as obs
         obs.enable()
 
@@ -221,9 +225,16 @@ def main():
         for k_, v in report.items():
             if isinstance(v, (int, float)):
                 obs.set_gauge(f"profile_lm/{k_}", float(v))
-        obs.export_chrome_trace(args.trace_out)
-        print(f"profile_lm: trace written to {args.trace_out}",
-              file=sys.stderr)
+        if args.trace_out:
+            obs.export_chrome_trace(args.trace_out)
+            print(f"profile_lm: trace written to {args.trace_out}",
+                  file=sys.stderr)
+        if args.metrics_out:
+            w = obs.MetricsWriter(args.metrics_out)
+            w.write(dict(report), kind="profile_lm")
+            w.close()
+            print(f"profile_lm: metrics appended to {args.metrics_out}",
+                  file=sys.stderr)
     print(json.dumps(report, indent=2))
 
 

@@ -66,9 +66,13 @@ def main():
     parser.add_argument("--trace-out", default=None,
                         help="enable the observability tracer; write a "
                              "Chrome-trace/Perfetto JSON here")
+    parser.add_argument("--metrics-out", default=None,
+                        help="append the component timings as one record "
+                             "of the versioned JSONL metrics stream "
+                             "(check_perf_regression.py input)")
     args = parser.parse_args()
     obs = None
-    if args.trace_out:
+    if args.trace_out or args.metrics_out:
         from chainermn_tpu import observability as obs
         obs.enable()
 
@@ -161,9 +165,19 @@ def main():
           (v48, x48), s2d_flops)
 
     if obs is not None:
-        obs.export_chrome_trace(args.trace_out)
-        print(f"profile_resnet: trace written to {args.trace_out}",
-              flush=True)
+        if args.trace_out:
+            obs.export_chrome_trace(args.trace_out)
+            print(f"profile_resnet: trace written to {args.trace_out}",
+                  flush=True)
+        if args.metrics_out:
+            # every bench() above published a profile_resnet/<tag>_ms gauge
+            gauges = {k: v for k, v in obs.get_tracer().gauges().items()
+                      if k.startswith("profile_resnet/")}
+            w = obs.MetricsWriter(args.metrics_out)
+            w.write(gauges, kind="profile_resnet")
+            w.close()
+            print(f"profile_resnet: metrics appended to {args.metrics_out}",
+                  flush=True)
 
 
 if __name__ == "__main__":
