@@ -554,6 +554,85 @@ def bench_decode():
     }
 
 
+def bench_serving():
+    """Continuous-batching serving perf: offered-load sweep over the
+    slot-managed engine (chainermn_tpu/serving/) — TTFT p50/p99,
+    tokens/s, slot occupancy per load point.
+
+    This is the BENCH trajectory's serving starting point: a tiny
+    random-init LM (serving perf is shape- not weight-dependent), a
+    4-slot pool, and two arrival regimes — ``load_high`` submits every
+    engine step (queue always backed up: occupancy and queue depth show
+    saturation behavior) and ``load_low`` submits every 4th step (pool
+    mostly idle: TTFT shows the unloaded floor).  All numbers come from
+    the engine's own metrics() — the same dict the Prometheus exporter
+    scrapes — so the bench, the gauges, and the regression gate
+    (scripts/check_perf_regression.py: ``_ms`` keys lower-is-better,
+    throughput higher) see one source of truth.
+    """
+    import jax
+    import numpy as np
+
+    import chainermn_tpu as mn
+    from chainermn_tpu.parallel import init_tp_transformer_lm
+    from chainermn_tpu.serving import AdmissionError, ServingEngine
+
+    vocab, d_model, n_heads, n_layers = 128, 32, 4, 2
+    n_slots, n_requests, s_p, new = 4, 8, 8, 8
+    params = init_tp_transformer_lm(
+        jax.random.PRNGKey(0), vocab, d_model, n_heads, n_layers,
+        max_len=s_p + new, pos_impl="rope")
+    mesh = mn.make_nd_mesh(("model",), (1,), jax.devices()[:1])
+    rs = np.random.RandomState(0)
+    prompts = rs.randint(0, vocab, (n_requests, s_p)).astype(np.int32)
+
+    def run_point(submit_every):
+        eng = ServingEngine(params, head_dim=d_model // n_heads,
+                            n_slots=n_slots, max_total=s_p + new, mesh=mesh,
+                            queue_capacity=n_requests)
+        # warm the compiles OUTSIDE the measured window (prefill + tick:
+        # max_new=2 keeps the slot active into the tick), then reset the
+        # stats clock: cold-compile TTFT is a one-off cost the
+        # steady-state serving numbers must not absorb.
+        h = eng.submit(prompts[0], 2)
+        eng.run(steps_budget=4)
+        assert h.status == "done", h.status
+        eng.reset_stats()
+        nxt, steps = 0, 0
+        while nxt < n_requests or eng.pool.busy_count > 0 \
+                or eng.scheduler.queue_depth > 0:
+            if nxt < n_requests and steps % submit_every == 0:
+                try:
+                    eng.submit(prompts[nxt], new)
+                except AdmissionError:
+                    pass  # backpressure counted in rejected_total
+                else:
+                    nxt += 1
+            eng.step()
+            steps += 1
+            if steps > 40 * n_requests * new:  # safety valve
+                break
+        m = eng.metrics()
+        return {
+            "tokens_per_sec": round(m["serving/tokens_per_sec"], 1),
+            "ttft_p50_ms": round(m.get("serving/ttft_p50_ms", 0.0), 2),
+            "ttft_p99_ms": round(m.get("serving/ttft_p99_ms", 0.0), 2),
+            "token_latency_p50_ms": round(
+                m.get("serving/token_latency_p50_ms", 0.0), 3),
+            "slot_occupancy_pct": round(m["serving/slot_occupancy_pct"], 1),
+            "rejected": m["serving/rejected_total"],
+            "steps": steps,  # bookkeeping; the gate's _SKIP drops it
+        }
+
+    return {
+        "config": f"d{d_model} L{n_layers} h{n_heads} V{vocab} "
+                  f"slots{n_slots} prompt{s_p} new{new} "
+                  f"x{n_requests} requests",
+        "load_high": run_point(1),
+        "load_low": run_point(4),
+    }
+
+
 def scaling_worker(n, grad_dtype=None, double_buffering=False):
     """Subprocess body: weak-scaling point on an n-device virtual CPU mesh.
 
@@ -997,6 +1076,7 @@ def main():
         "transformer_lm": None,
         "transformer_lm_large": None,
         "decode": None,
+        "serving": None,
         "data_path": None,
         "long_context": None,
         "projected_scaling": projected,
@@ -1034,6 +1114,10 @@ def main():
             "decode_greedy_ms_tok": g(result, "decode",
                                       "greedy_ms_per_token"),
             "decode_beam4_ms_tok": g(result, "decode", "beam4_ms_per_token"),
+            "serving_tps_high": g(result, "serving", "load_high",
+                                  "tokens_per_sec"),
+            "serving_ttft_p99_ms": g(result, "serving", "load_low",
+                                     "ttft_p99_ms"),
             "flash_s8192_mfu": g(result, "long_context",
                                  "flash_fwd_bwd_S8192", "attn_mfu"),
             "flash_s16384_mfu": g(result, "long_context",
@@ -1144,6 +1228,20 @@ def main():
             emit()
     elif on_tpu:
         print("bench: over budget — decode section skipped", file=sys.stderr)
+
+    # --- serving: continuous-batching engine offered-load sweep ------------
+    # Runs on every backend (the engine is the same host loop + compiled
+    # tick everywhere; on CPU this is the serving trajectory's anchor).
+    if not over_budget():
+        try:
+            result["serving"] = bench_serving()
+            emit("serving")
+        except Exception as e:
+            print(f"bench: serving section failed: {e!r}", file=sys.stderr)
+            emit()
+    else:
+        print("bench: over budget — serving section skipped",
+              file=sys.stderr)
 
     # --- input pipeline: disk-fed vs synthetic -----------------------------
     if on_tpu and not over_budget():

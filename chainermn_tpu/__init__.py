@@ -13,7 +13,7 @@ from . import _compat
 _compat.install()  # jax version shims (shard_map name, axis_size) — must
 # run before any submodule resolves those symbols.
 
-from . import extensions, functions, global_except_hook, iterators, links, observability, ops, parallel, runtime, training  # noqa: F401,E402
+from . import extensions, functions, global_except_hook, iterators, links, observability, ops, parallel, runtime, serving, training  # noqa: F401,E402
 from .runtime import (FileDataset, PrefetchIterator,  # noqa: F401
                       write_file_dataset)
 from .parallel import (  # noqa: F401

@@ -95,9 +95,39 @@ def cache_append(kc, vc, k_new, v_new, pos, *, axis: int = 1,
     write takes the dus path rather than risk silent corruption.
     ``interpret=True`` (with ``impl='pallas'``) runs the kernel in
     interpret mode for off-chip parity tests.
+
+    **Per-row positions** (the serving cache pool's contract): ``pos``
+    may be a RANK-1 vector of length ``kc.shape[0]`` — row ``b`` of the
+    new K/V is then written at ``pos[b]`` along ``axis``, independently
+    per row (a vmapped ``dynamic_update_slice``).  Every slot in a
+    continuous-batching pool sits at its own sequence length, so the
+    one-token-per-active-slot tick needs exactly this ragged write.
+    Scalar ``pos`` behavior is unchanged; the vector path is XLA-only
+    (``impl='pallas'`` with a vector raises — the scatter kernel maps a
+    single block per call).
     """
     if impl not in ("auto", "pallas", "xla"):
         raise ValueError(f"impl must be auto|pallas|xla, got {impl!r}")
+    if not isinstance(pos, (int, np.integer)) and getattr(pos, "ndim", 0) == 1:
+        if impl == "pallas":
+            raise ValueError(
+                "impl='pallas' supports scalar pos only; a per-row position "
+                "vector takes the vmapped dynamic_update_slice path "
+                "(impl='auto' or 'xla')")
+        if axis < 1:
+            raise ValueError(
+                f"per-row pos needs the row axis (0) distinct from the "
+                f"write axis, got axis={axis}")
+        if pos.shape[0] != kc.shape[0]:
+            raise ValueError(
+                f"per-row pos length {pos.shape[0]} != leading (row) dim "
+                f"{kc.shape[0]} of the cache {kc.shape}")
+
+        def _row_write(c, n, p):
+            return jax.lax.dynamic_update_slice_in_dim(c, n, p, axis - 1)
+
+        return (jax.vmap(_row_write)(kc, k_new, pos),
+                jax.vmap(_row_write)(vc, v_new, pos))
     # Pallas envelope: a single-row write whose position axis is the
     # SECOND-MINOR dim (the attention-native cache layouts put positions
     # there) with an 8-divisible extent — the mapped block is then the

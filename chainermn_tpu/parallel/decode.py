@@ -55,7 +55,11 @@ def _decoder_core(params, head_dim: int, axis_name: str):
                                      axis_name=axis_name)
         x = x * (d_model ** 0.5)
         if not rope:
-            x = x + jnp.take(params["pos_embed"], positions, axis=0)[None]
+            pe = jnp.take(params["pos_embed"], positions, axis=0)
+            # (S,) positions broadcast over the batch; (N, S) positions
+            # (the serving tick: every slot at its own length) index
+            # per row.
+            x = x + (pe if positions.ndim == 2 else pe[None])
         return x
 
     def block_with(x, blk, positions, attend):
@@ -83,6 +87,12 @@ def _decoder_core(params, head_dim: int, axis_name: str):
         """x (N,S,D) → block output; caches written at ``write_at + i`` for
         the i-th input position; query i attends cache [:q_valid + i + 1).
 
+        ``write_at``/``q_valid`` may be RANK-1 vectors of length N (the
+        serving tick): row ``b`` then writes at ``write_at[b]`` and
+        attends its own prefix ``[:q_valid[b] + i + 1)`` — the ragged
+        iteration-level batch, on the einsum path (the flash-decode
+        kernel maps one scalar position per call).
+
         Cache layout is FLAT — ``(B, total, H_kv·head_dim)`` — so every
         cache load streams dense 128-lane rows; per-head structure is
         recovered by view reshapes (einsum fallback) or the segmented
@@ -93,6 +103,7 @@ def _decoder_core(params, head_dim: int, axis_name: str):
         (scripts/profile_decode.py + the round-5 HLO dump).
         """
         n = x.shape[0]
+        per_row = getattr(write_at, "ndim", 0) == 1
 
         def attend(q, k, v):
             from ..ops.kv_cache import cache_append
@@ -120,7 +131,7 @@ def _decoder_core(params, head_dim: int, axis_name: str):
             from ..ops.decode_attention import (_pick_block_s,
                                                  decode_attend,
                                                  decode_attend_gqa)
-            if s_q == 1 and jax.default_backend() == "tpu" \
+            if s_q == 1 and not per_row and jax.default_backend() == "tpu" \
                     and _pick_block_s(kc.shape[1]) > 0:
                 # DECODE on TPU: one flash-decode Pallas pass — cache
                 # read once at full lane density (ops/decode_attention).
@@ -145,7 +156,13 @@ def _decoder_core(params, head_dim: int, axis_name: str):
             total = kc.shape[1]
             kc4 = kc.reshape(n, total, hkv, head_dim)
             vc4 = vc.reshape(n, total, hkv, head_dim)
-            valid = (q_valid + jnp.arange(s_q) + 1)[None, None, None, :, None]
+            if per_row:
+                # (n, 1, 1, s_q, 1): each row's own valid prefix
+                valid = (q_valid[:, None] + jnp.arange(s_q)[None] + 1
+                         )[:, None, None, :, None]
+            else:
+                valid = (q_valid + jnp.arange(s_q) + 1
+                         )[None, None, None, :, None]
             # Grouped attention against the UN-expanded cache (GQA's
             # inference payoff): q heads regrouped onto their KV head — no
             # per-tick n_heads-sized cache copy.
@@ -200,7 +217,74 @@ def _prefill(params, embed, attn_block, prompt, total: int, head_dim: int):
     return _layer_norm(x, params["lnf_scale"], params["lnf_bias"]), caches
 
 
-def _make_face(mesh: Optional[Mesh], axis_name: str, inner, has_rng: bool):
+def _greedy_token(table, h_last, axis_name: str):
+    """Vocab-parallel greedy next token from ``h_last (N, D)`` against the
+    VOCAB-SHARDED embedding ``table (V/P, D)``: per-shard (max, argmax)
+    then a global (pmax, pmin-over-winners) pair — the full ``(N, V)``
+    logits never materialize on one chip.  An exact-fp tie across shards
+    resolves to the LOWEST winning index (argmax convention).  Shared by
+    :func:`lm_generate` (``temperature=0``) and the serving engine's
+    per-tick step, so batched-slot decode is token-exact against the
+    closed-batch generator."""
+    vocab_per = table.shape[0]
+    start = jax.lax.axis_index(axis_name) * vocab_per
+    logits = jnp.einsum("bd,vd->bv", h_last, table,
+                        preferred_element_type=jnp.float32)
+    local_best = logits.max(-1)
+    local_idx = start + logits.argmax(-1)
+    gbest = jax.lax.pmax(local_best, axis_name)
+    winner = (local_best == gbest)
+    return jax.lax.pmin(
+        jnp.where(winner, local_idx, jnp.int32(2 ** 30)), axis_name)
+
+
+def lm_prefill(params, prompt, total: int, *, head_dim: int, axis_name: str):
+    """Iteration-level PREFILL step: run the full ``prompt (B, S_p)``
+    through the stack, returning ``(h, caches)`` — ``h (B, S_p, D)`` is
+    the post-final-layer-norm hidden state (greedy-select the first
+    generated token from ``h[:, s_real - 1]``), and ``caches`` is the
+    per-layer list of flat ``(B, total, H_kv·head_dim)`` K/V pairs with
+    the prompt written at rows ``[0, S_p)``.
+
+    Call INSIDE ``shard_map`` with the model axis bound.  This is the
+    "prefill(prompt) → slot" half of the serving engine's per-tick API
+    (``chainermn_tpu/serving/engine.py``): the caches slot straight into
+    a pool row, and generation continues via :func:`lm_decode_tick` —
+    no closed ``lax.scan`` batch required.
+    """
+    embed, attn_block, _, rope = _decoder_core(params, head_dim, axis_name)
+    _check_length(params, total, rope)
+    return _prefill(params, embed, attn_block, prompt, total, head_dim)
+
+
+def lm_decode_tick(params, tokens, caches, pos, *, head_dim: int,
+                   axis_name: str):
+    """ONE iteration-level decode tick: consume ``tokens (N,)`` (the last
+    emitted token per row), write each row's K/V at ``pos`` and attend
+    its own cache prefix ``[0, pos]``, returning ``(h_last (N, D),
+    new_caches)`` — feed ``h_last`` to :func:`_greedy_token` (or a
+    sampler) for the next token.
+
+    ``pos`` is a scalar (all rows at the same position — the closed
+    ``lm_generate`` batch) or an ``(N,)`` int32 vector (every row at its
+    OWN position — the serving engine's slot pool, where sequences are
+    inserted and evicted between ticks).  Call INSIDE ``shard_map`` with
+    the model axis bound.
+    """
+    embed, attn_block, _, _ = _decoder_core(params, head_dim, axis_name)
+    per_row = getattr(pos, "ndim", 0) == 1
+    positions = pos[:, None] if per_row else pos[None]
+    x = embed(tokens[:, None], positions)
+    new_caches = []
+    for blk, (kc, vc) in zip(params["blocks"], caches):
+        x, kc, vc = attn_block(x, blk, kc, vc, positions, pos, pos)
+        new_caches.append((kc, vc))
+    h = _layer_norm(x, params["lnf_scale"], params["lnf_bias"])
+    return h[:, -1], new_caches
+
+
+def _make_face(mesh: Optional[Mesh], axis_name: str, inner, has_rng: bool,
+               requires_rng: bool = False):
     """Shared jit face for the generators: resolve the mesh, cache one
     compiled shard_map program per param STRUCTURE, device_put per spec."""
     from .._compat import shard_map
@@ -224,7 +308,14 @@ def _make_face(mesh: Optional[Mesh], axis_name: str, inner, has_rng: bool):
             params, specs)
         if has_rng:
             if rng is None:
-                rng = jax.random.PRNGKey(0)
+                if requires_rng:
+                    raise ValueError(
+                        "temperature > 0 samples tokens and needs an "
+                        "explicit rng: pass jax.random.PRNGKey(...) as the "
+                        "third argument (the old silent PRNGKey(0) fallback "
+                        "made every default-rng call draw IDENTICAL token "
+                        "sequences)")
+                rng = jax.random.PRNGKey(0)  # unused at temperature == 0
             return cache[key](sharded, prompt, rng)
         return cache[key](sharded, prompt)
 
@@ -240,34 +331,37 @@ def lm_generate(params, prompt, rng: Optional[jax.Array] = None, *,
     Call INSIDE ``shard_map`` with the model axis bound (use
     :func:`make_lm_generator` for the jit face).  Returns ``(B,
     max_new_tokens) int32``.
+
+    RNG CONTRACT: ``temperature > 0`` requires an explicit ``rng`` —
+    sampling with a process-constant default key would draw the SAME
+    Gumbel noise on every call, so every "random" generation from the
+    same prompt would emit identical tokens.  The jit face
+    (:func:`make_lm_generator`) enforces this with a ``ValueError``;
+    ``temperature == 0`` ignores ``rng`` entirely.
     """
     b, s_p = prompt.shape
     total = s_p + max_new_tokens
-    embed, attn_block, _, rope = _decoder_core(params, head_dim, axis_name)
-    _check_length(params, total, rope)
-    blocks = params["blocks"]
 
     def logits_next(h_last, step_pos):
         """Vocab-parallel next-token choice from ``h_last (B, D)``;
         ``step_pos`` (the position being generated) salts the sampling key
         so every step draws FRESH Gumbel noise."""
         table = params["embed"]
+        if temperature <= 0.0:
+            return _greedy_token(table, h_last, axis_name)
         vocab_per = table.shape[0]
         start = jax.lax.axis_index(axis_name) * vocab_per
         logits = jnp.einsum("bd,vd->bv", h_last, table,
                             preferred_element_type=jnp.float32)
-        if temperature > 0.0:
-            # Gumbel trick on the SHARDED logits: per-shard argmax of
-            # (logit/T + gumbel) then a global (value, index) max — exact
-            # categorical sampling without materializing (B, V) anywhere.
-            key = jax.random.fold_in(
-                jax.random.fold_in(rng, step_pos),
-                jax.lax.axis_index(axis_name))
-            gumbel = -jnp.log(-jnp.log(
-                jax.random.uniform(key, logits.shape, minval=1e-20)))
-            scored = logits / temperature + gumbel
-        else:
-            scored = logits
+        # Gumbel trick on the SHARDED logits: per-shard argmax of
+        # (logit/T + gumbel) then a global (value, index) max — exact
+        # categorical sampling without materializing (B, V) anywhere.
+        key = jax.random.fold_in(
+            jax.random.fold_in(rng, step_pos),
+            jax.lax.axis_index(axis_name))
+        gumbel = -jnp.log(-jnp.log(
+            jax.random.uniform(key, logits.shape, minval=1e-20)))
+        scored = logits / temperature + gumbel
         local_best = scored.max(-1)
         local_idx = start + scored.argmax(-1)
         gbest = jax.lax.pmax(local_best, axis_name)
@@ -278,20 +372,19 @@ def lm_generate(params, prompt, rng: Optional[jax.Array] = None, *,
             jnp.where(winner, local_idx, jnp.int32(2 ** 30)), axis_name)
 
     # ---- prefill: full prompt through the stack, caches written ----
-    h, caches = _prefill(params, embed, attn_block, prompt, total, head_dim)
+    h, caches = lm_prefill(params, prompt, total, head_dim=head_dim,
+                           axis_name=axis_name)
     first = logits_next(h[:, -1], jnp.int32(s_p))
 
-    # ---- decode: one token per scan tick ----
+    # ---- decode: one iteration-level tick per scan step (the SAME
+    # per-tick step the serving engine drives between insert/evict) ----
     def tick(carry, i):
         token, caches = carry
         pos = s_p + i - 1  # tick i consumes the (i-1)-th generated token
-        x = embed(token[:, None], pos[None])
-        new_caches = []
-        for blk, (kc, vc) in zip(blocks, caches):
-            x, kc, vc = attn_block(x, blk, kc, vc, pos[None], pos, pos)
-            new_caches.append((kc, vc))
-        h = _layer_norm(x, params["lnf_scale"], params["lnf_bias"])
-        nxt = logits_next(h[:, -1], s_p + i)
+        h_last, new_caches = lm_decode_tick(
+            params, token, caches, pos, head_dim=head_dim,
+            axis_name=axis_name)
+        nxt = logits_next(h_last, s_p + i)
         return (nxt, new_caches), token
 
     (last, _), toks = jax.lax.scan(
@@ -645,9 +738,14 @@ def make_lm_generator(mesh: Optional[Mesh] = None, axis_name: str = "model",
                       *, head_dim: int, max_new_tokens: int,
                       temperature: float = 0.0):
     """Eager/jit face: ``fn(params, prompt[, rng]) -> (B, max_new) tokens``
-    over TP-sharded global params (``transformer_lm_specs`` layout)."""
+    over TP-sharded global params (``transformer_lm_specs`` layout).
+
+    RNG CONTRACT: with ``temperature > 0`` the ``rng`` argument is
+    REQUIRED (``ValueError`` otherwise) — a silent default key would make
+    every call sample the identical token sequence.  At ``temperature ==
+    0`` (greedy) ``rng`` is ignored and may be omitted."""
     return _make_face(
         mesh, axis_name,
         partial(lm_generate, head_dim=head_dim, axis_name=axis_name,
                 max_new_tokens=max_new_tokens, temperature=temperature),
-        has_rng=True)
+        has_rng=True, requires_rng=temperature > 0.0)

@@ -51,15 +51,23 @@ def apply_rope(x, positions, *, base: float = 10000.0):
     attention decay, extrapolation-friendly): rotate each head-dim pair by
     ``position · base^(-2i/d)``.  ``positions (S,)`` are GLOBAL token
     positions, so sequence-parallel shards pass ``my_shard_offset +
-    arange(S_local)`` and the ring stays exact.  ``head_dim`` must be even.
+    arange(S_local)`` and the ring stays exact.  A 2-D ``positions
+    (B, S)`` rotates each batch row at its OWN positions — the serving
+    tick's contract, where every slot sits at a different sequence
+    length.  ``head_dim`` must be even.
     """
     half = x.shape[-1] // 2
     if x.shape[-1] % 2:
         raise ValueError(f"RoPE needs an even head_dim, got {x.shape[-1]}")
     freqs = base ** (-jnp.arange(half, dtype=jnp.float32) / half)
-    ang = positions.astype(jnp.float32)[:, None] * freqs[None]     # (S, half)
-    cos = jnp.cos(ang)[None, :, None, :]
-    sin = jnp.sin(ang)[None, :, None, :]
+    if positions.ndim == 2:                                  # per-row (B, S)
+        ang = positions.astype(jnp.float32)[..., None] * freqs  # (B, S, half)
+        cos = jnp.cos(ang)[:, :, None, :]
+        sin = jnp.sin(ang)[:, :, None, :]
+    else:
+        ang = positions.astype(jnp.float32)[:, None] * freqs[None]  # (S, half)
+        cos = jnp.cos(ang)[None, :, None, :]
+        sin = jnp.sin(ang)[None, :, None, :]
     x1, x2 = x[..., :half].astype(jnp.float32), x[..., half:].astype(jnp.float32)
     return jnp.concatenate(
         [x1 * cos - x2 * sin, x1 * sin + x2 * cos], -1).astype(x.dtype)

@@ -1,0 +1,239 @@
+#!/usr/bin/env python
+"""CLI demo: continuous-batching serving over the toy-corpus LM.
+
+``python -m chainermn_tpu.serve`` trains the same tiny
+arithmetic-progression LM as ``examples/generate`` (each next token =
+previous + step mod V — learnable, so correct serving output is
+eyeballable), then stands up a :class:`chainermn_tpu.serving
+.ServingEngine` and pushes a STAGGERED request schedule through it:
+the first wave saturates the slot pool, later waves arrive while it is
+still decoding, and the engine interleaves them at iteration level —
+the thing the closed-batch generator cannot do.
+
+Outputs: per-request streamed lines on stderr, ONE summary JSON line on
+stdout (request outcomes + the serving metrics dict), optional
+``--metrics-out`` JSONL stream (``chainermn_tpu.metrics.v1`` records,
+kinds ``serving_step``/``serving_summary``) and ``--prom-out``
+Prometheus textfile — both the formats the observability layer already
+exports and ``scripts/check_perf_regression.py`` gates on.
+
+Run:  python -m chainermn_tpu.serve --devices 8 --tp 2
+      python -m chainermn_tpu.serve --steps-budget 40 --requests 8 \
+          --metrics-out /tmp/serve.jsonl --prom-out /tmp/serve.prom
+"""
+
+import argparse
+import json
+import os
+import sys
+
+
+def make_corpus(rng, n, seq_len, vocab):
+    """Arithmetic progressions mod vocab (examples/generate's corpus)."""
+    import numpy as np
+
+    starts = rng.randint(0, vocab, n)
+    steps = rng.randint(1, 4, n)
+    pos = np.arange(seq_len + 1)
+    return ((starts[:, None] + steps[:, None] * pos[None]) % vocab
+            ).astype("int32")
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="ChainerMN-TPU serving demo: continuous-batching "
+                    "inference over a slot-managed KV-cache pool")
+    parser.add_argument("--devices", type=int, default=0,
+                        help="force N virtual CPU devices (0 = leave the "
+                             "backend alone; ignored once jax initialized)")
+    parser.add_argument("--tp", type=int, default=1,
+                        help="model-axis width for serving")
+    parser.add_argument("--vocab", type=int, default=64)
+    parser.add_argument("--d-model", type=int, default=32)
+    parser.add_argument("--n-heads", type=int, default=4)
+    parser.add_argument("--kv-heads", type=int, default=None)
+    parser.add_argument("--n-layers", type=int, default=2)
+    parser.add_argument("--seq-len", type=int, default=24)
+    parser.add_argument("--pos-impl", default="learned",
+                        choices=["learned", "rope"])
+    parser.add_argument("--train-steps", type=int, default=60,
+                        help="toy-LM training steps before serving")
+    parser.add_argument("--lr", type=float, default=1e-2)
+    parser.add_argument("--n-slots", type=int, default=4)
+    parser.add_argument("--max-total", type=int, default=None,
+                        help="per-slot capacity (default: fits prompt + "
+                             "max-new)")
+    parser.add_argument("--queue-capacity", type=int, default=16)
+    parser.add_argument("--requests", type=int, default=8)
+    parser.add_argument("--prompt-len", type=int, default=6)
+    parser.add_argument("--max-new-tokens", type=int, default=8)
+    parser.add_argument("--stagger-every", type=int, default=2,
+                        help="submit one later-wave request every N engine "
+                             "steps after the first wave")
+    parser.add_argument("--steps-budget", type=int, default=None,
+                        help="hard cap on engine iterations (the run exits "
+                             "cleanly with whatever finished)")
+    parser.add_argument("--metrics-out", default=None,
+                        help="JSONL metrics stream (serving_step records + "
+                             "serving_summary roll-up)")
+    parser.add_argument("--prom-out", default=None,
+                        help="Prometheus textfile with the serving gauges")
+    parser.add_argument("--trace-out", default=None,
+                        help="enable the tracer; Chrome-trace JSON with the "
+                             "per-request serving spans/instants")
+    args = parser.parse_args(argv)
+
+    if args.devices:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={args.devices}")
+
+    import jax
+    import numpy as np
+    import optax
+    from functools import partial
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    import chainermn_tpu as mn
+    from chainermn_tpu import observability as obs
+    from chainermn_tpu.parallel import (
+        init_tp_transformer_lm, make_hybrid_shard_map_step, shard_pytree,
+        state_specs_like, tp_transformer_lm_loss, transformer_lm_specs)
+    from chainermn_tpu.serving import AdmissionError, ServingEngine
+
+    if args.trace_out:
+        obs.enable()
+
+    n = len(jax.devices())
+    if n % args.tp:
+        raise SystemExit(f"--tp {args.tp} does not divide {n} devices")
+    dp = n // args.tp
+    head_dim = args.d_model // args.n_heads
+    total_len = args.prompt_len + args.max_new_tokens
+    max_len = max(args.seq_len, total_len)
+
+    # ---- train the toy LM (same recipe as examples/generate) ----
+    params = init_tp_transformer_lm(
+        jax.random.PRNGKey(0), args.vocab, args.d_model, args.n_heads,
+        args.n_layers, max_len=max_len, pos_impl=args.pos_impl,
+        n_kv_heads=args.kv_heads)
+    train_mesh = mn.make_nd_mesh(("data", "model"), (dp, args.tp))
+    specs = transformer_lm_specs(params, "model")
+    optimizer = optax.adam(args.lr)
+    loss_fn = partial(tp_transformer_lm_loss, head_dim=head_dim,
+                      axis_name="model")
+    step = make_hybrid_shard_map_step(loss_fn, optimizer, train_mesh, params,
+                                      specs, donate=False)
+    p = shard_pytree(params, train_mesh, specs)
+    st = shard_pytree(optimizer.init(params), train_mesh,
+                      state_specs_like(optimizer, params, specs))
+    rng = np.random.RandomState(0)
+    for i in range(args.train_steps):
+        tokens = make_corpus(rng, 8 * dp, args.seq_len, args.vocab)
+        batch = (jax.device_put(tokens, NamedSharding(train_mesh, P("data"))),)
+        p, st, loss = step(p, st, batch)
+        if i % 30 == 0 or i == args.train_steps - 1:
+            print(f"train step {i:3d}  loss {float(loss):.4f}",
+                  file=sys.stderr)
+    trained = jax.tree_util.tree_map(np.asarray, p)  # global host copy
+
+    # ---- serve ----
+    serve_mesh = mn.make_nd_mesh(("model",), (args.tp,),
+                                 jax.devices()[: args.tp])
+    writer = None
+    if args.metrics_out:
+        from chainermn_tpu.observability.export import MetricsWriter
+        writer = MetricsWriter(args.metrics_out)
+    eng = ServingEngine(
+        trained, head_dim=head_dim, n_slots=args.n_slots,
+        max_total=args.max_total or max(total_len, 8),
+        mesh=serve_mesh, queue_capacity=args.queue_capacity,
+        metrics_writer=writer)
+
+    test = make_corpus(np.random.RandomState(99), args.requests,
+                       max(args.seq_len, total_len), args.vocab)
+    prompts = test[:, : args.prompt_len]
+    want = test[:, args.prompt_len: args.prompt_len + args.max_new_tokens]
+
+    def stream(tok, rid):
+        print(f"request {rid}: token {tok}", file=sys.stderr)
+
+    handles, rejected = {}, {}
+    first_wave = min(args.n_slots, args.requests)
+
+    def submit(i):
+        try:
+            handles[i] = eng.submit(prompts[i], args.max_new_tokens,
+                                    on_token=stream)
+        except AdmissionError as e:
+            rejected[i] = e.reason
+            print(f"request {i} rejected: {e}", file=sys.stderr)
+
+    for i in range(first_wave):
+        submit(i)
+    steps = 0
+    nxt = first_wave
+    budget = args.steps_budget
+
+    def can_step():
+        return budget is None or steps < budget
+
+    while can_step() and (nxt < args.requests
+                          or eng.scheduler.queue_depth > 0
+                          or eng.pool.busy_count > 0):
+        eng.step()
+        steps += 1
+        if nxt < args.requests and steps % max(args.stagger_every, 1) == 0:
+            submit(nxt)
+            nxt += 1
+
+    # ---- report ----
+    per_request = []
+    correct = []
+    for i in range(args.requests):
+        if i in rejected:
+            per_request.append({"id": i, "status": "rejected",
+                                "reason": rejected[i]})
+            continue
+        h = handles.get(i)
+        if h is None:
+            per_request.append({"id": i, "status": "not_submitted"})
+            continue
+        toks = h.tokens
+        row = {"id": h.id, "status": h.status,
+               "finish_reason": h.finish_reason,
+               "n_tokens": len(toks),
+               "ttft_ms": (round(h.ttft_ms, 2)
+                           if h.ttft_ms is not None else None)}
+        if h.status == "done" and len(toks) == args.max_new_tokens:
+            acc = float((np.asarray(toks) == want[i]).mean())
+            row["continuation_accuracy"] = round(acc, 3)
+            correct.append(acc)
+        per_request.append(row)
+        print(f"prompt {prompts[i].tolist()} -> {toks} "
+              f"(true continuation {want[i].tolist()})", file=sys.stderr)
+
+    metrics = eng.metrics()
+    if writer is not None:
+        eng.finalize_metrics()
+        writer.close()
+    if args.prom_out:
+        eng.write_prometheus(args.prom_out)
+    if args.trace_out:
+        obs.export_chrome_trace(args.trace_out)
+    summary = {
+        "schema": "chainermn_tpu.serve.v1",
+        "engine_steps": steps,
+        "requests": per_request,
+        "mean_continuation_accuracy": (
+            round(float(np.mean(correct)), 3) if correct else None),
+        "metrics": {k: round(float(v), 3) for k, v in metrics.items()},
+    }
+    print(json.dumps(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

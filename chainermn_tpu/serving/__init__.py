@@ -1,0 +1,60 @@
+"""Continuous-batching inference serving (iteration-level scheduling).
+
+The ROADMAP north star is a system that "serves heavy traffic from
+millions of users"; the decode stack (``parallel/decode.py``,
+``ops/kv_cache.py``) is the fast half of that story, and this package is
+the serving half: instead of the closed batch-synchronous ``lax.scan``
+of ``lm_generate`` — which admits requests only at t=0 and holds the
+whole batch until the longest sequence finishes — the engine here runs
+ONE compiled decode tick per iteration over a fixed pool of KV-cache
+slots and inserts/evicts sequences BETWEEN ticks (Orca-style
+iteration-level scheduling, the batching model vLLM popularized).
+
+Layers (host → device):
+
+* :mod:`~chainermn_tpu.serving.scheduler` — bounded admission queue with
+  reject-with-reason backpressure, FIFO admission into free slots,
+  EOS/length/deadline eviction.  Pure host Python, jax-free.
+* :mod:`~chainermn_tpu.serving.cache_pool` — the slot-managed KV-cache
+  pool: per-layer ``(n_slots, max_total, H_kv·head_dim)`` device
+  buffers + a per-slot write-position vector; freed slots are recycled
+  without reallocation or re-jit (the tick program's shapes never
+  change).
+* :mod:`~chainermn_tpu.serving.engine` — the compiled per-tick step
+  (``prefill(prompt) → slot``, ``tick(slots) → one token per active
+  slot``) built from ``parallel/decode.py``'s ``lm_prefill`` /
+  ``lm_decode_tick``.
+* :mod:`~chainermn_tpu.serving.frontend` — the threaded Python API:
+  ``ServingEngine.submit() -> RequestHandle`` with streaming token
+  callbacks, plus the observability wiring (per-request phase
+  timestamps/spans, serving gauges through the tracer and the
+  Prometheus/JSONL exporters).
+
+``python -m chainermn_tpu.serve`` is the CLI demo over the toy-corpus
+LM from ``examples/generate``.  See docs/SERVING.md.
+"""
+
+from .scheduler import (  # noqa: F401
+    AdmissionError,
+    Request,
+    Scheduler,
+)
+from .cache_pool import SlotAllocator  # noqa: F401
+
+__all__ = ["AdmissionError", "Request", "Scheduler", "SlotAllocator",
+           "ServingEngine", "RequestHandle", "CachePool", "DecodeEngine"]
+
+
+def __getattr__(name):
+    # The device-side halves import jax; keep `import chainermn_tpu.serving`
+    # cheap for host-only consumers (the scheduler fuzz tests).
+    if name in ("ServingEngine", "RequestHandle"):
+        from . import frontend
+        return getattr(frontend, name)
+    if name == "CachePool":
+        from .cache_pool import CachePool
+        return CachePool
+    if name == "DecodeEngine":
+        from .engine import DecodeEngine
+        return DecodeEngine
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

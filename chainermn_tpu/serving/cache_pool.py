@@ -1,0 +1,138 @@
+"""Slot-managed KV-cache pool for continuous-batching decode.
+
+The pool is the device half of iteration-level scheduling: ONE set of
+per-layer flat K/V buffers shaped ``(n_slots, max_total, H_kv·head_dim)``
+(the same flat layout ``parallel/decode.py`` streams at full lane
+density), allocated once, plus a per-slot int32 write-position vector.
+Admitting a request means writing its prefill slab into a free slot's
+rows ``[0, s_p)`` and setting ``pos[slot] = s_p``; every decode tick
+appends one row per slot at its own ``pos`` (the per-row vector
+``ops.kv_cache.cache_append`` path) and advances it; eviction just
+returns the slot index to the free list.  Nothing is reallocated and
+nothing re-jits: the tick program's operand shapes are fixed for the
+pool's lifetime, which is the whole point — a freed slot is recycled by
+the NEXT prefill while the other slots keep decoding.
+
+Correctness of recycling without zeroing: a slot's rows ``> pos`` may
+hold a previous occupant's K/V, but every attention read is masked to
+the occupant's own prefix ``[0, pos]``, and row ``p`` is written by the
+current occupant strictly before ``pos`` reaches ``p`` (prefill writes
+``[0, s_p)``; each tick writes row ``pos`` before attending it).  Stale
+rows are therefore unreachable — asserted token-exactly by the
+cross-talk fuzz in tests/test_serving.py.
+
+:class:`SlotAllocator` is the jax-free bookkeeping half (fuzzable
+standalone); :class:`CachePool` adds the device buffers.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+
+class SlotAllocator:
+    """Free-list slot bookkeeping: acquire → occupied, release → recycled.
+
+    Slots are handed out lowest-index-first (deterministic for tests);
+    double-release and foreign releases raise — a slot leak in a serving
+    loop is silent capacity loss, so the invariants are hard errors.
+    """
+
+    def __init__(self, n_slots: int):
+        if n_slots < 1:
+            raise ValueError(f"n_slots must be >= 1, got {n_slots}")
+        self.n_slots = int(n_slots)
+        self._free: List[int] = list(range(self.n_slots))
+        self._busy: set = set()
+
+    def acquire(self) -> Optional[int]:
+        """Lowest free slot index, or None when the pool is saturated."""
+        if not self._free:
+            return None
+        slot = self._free.pop(0)
+        self._busy.add(slot)
+        return slot
+
+    def release(self, slot: int) -> None:
+        if slot not in self._busy:
+            raise ValueError(f"slot {slot} is not busy (double release or "
+                             f"foreign slot); busy={sorted(self._busy)}")
+        self._busy.remove(slot)
+        # keep the free list sorted so acquisition order is deterministic
+        self._free.append(slot)
+        self._free.sort()
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    @property
+    def busy_count(self) -> int:
+        return len(self._busy)
+
+    def check_invariants(self) -> None:
+        """No leak, no alias: free ∪ busy is exactly {0..n_slots-1}."""
+        free, busy = set(self._free), set(self._busy)
+        assert not (free & busy), (free, busy)
+        assert free | busy == set(range(self.n_slots)), (free, busy)
+
+
+class CachePool:
+    """Device-buffer half: per-layer flat K/V pools + per-slot positions.
+
+    ``caches`` is the pytree the engine's compiled programs thread
+    through (list of ``(k, v)`` per layer, each ``(n_slots, max_total,
+    kv_dim)`` sharded ``P(None, None, axis)`` over the model axis — each
+    chip holds only its local heads' columns, exactly the closed-batch
+    decoder's TP layout).  ``pos`` lives HOST-side as numpy (the
+    scheduler reads/writes it every tick; shipping it to device happens
+    once per tick as a tiny operand).
+    """
+
+    def __init__(self, n_slots: int, max_total: int, n_layers: int,
+                 kv_dim: int, dtype, mesh, axis_name: str = "model"):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        if max_total < 2:
+            raise ValueError(f"max_total must be >= 2, got {max_total}")
+        self.allocator = SlotAllocator(n_slots)
+        self.n_slots = int(n_slots)
+        self.max_total = int(max_total)
+        self.n_layers = int(n_layers)
+        self.kv_dim = int(kv_dim)
+        self.axis_name = axis_name
+        self.mesh = mesh
+        self.cache_spec = P(None, None, axis_name)
+        sharding = NamedSharding(mesh, self.cache_spec)
+        shape = (self.n_slots, self.max_total, self.kv_dim)
+        self.caches = [
+            (jax.device_put(jnp.zeros(shape, dtype), sharding),
+             jax.device_put(jnp.zeros(shape, dtype), sharding))
+            for _ in range(self.n_layers)]
+        # host-side per-slot NEXT-WRITE position (== sequence length so
+        # far).  The tick advances EVERY slot's pos (one fixed program),
+        # so a free slot's position drifts upward until the next prefill
+        # resets it; its garbage writes land at the drifting row (clamped
+        # to max_total-1 by dynamic_update_slice) INSIDE ITS OWN SLOT
+        # ROW, which stays safe by the module-docstring argument: the
+        # next occupant rewrites row p before its own pos reaches p.
+        self.pos = np.zeros(self.n_slots, np.int32)
+
+    # thin faces over the allocator (the frontend talks to the pool)
+    def acquire(self) -> Optional[int]:
+        return self.allocator.acquire()
+
+    def release(self, slot: int) -> None:
+        self.pos[slot] = 0
+        self.allocator.release(slot)
+
+    @property
+    def free_count(self) -> int:
+        return self.allocator.free_count
+
+    @property
+    def busy_count(self) -> int:
+        return self.allocator.busy_count

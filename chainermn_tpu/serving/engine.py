@@ -1,0 +1,195 @@
+"""Compiled per-tick decode programs over the slot pool.
+
+The closed-batch generator (``parallel/decode.py::lm_generate``) fuses
+prefill + a ``lax.scan`` over new tokens into ONE program — great for
+offline batches, useless for serving: nothing can join or leave until
+the whole scan retires.  This engine splits the same numerics into two
+programs driven from the host, one tick at a time:
+
+* **prefill_into_slot** — full-prompt forward (``lm_prefill``), greedy
+  first token from the LAST REAL prompt position, and a
+  ``dynamic_update_slice`` of the prompt's K/V slab into the target
+  slot's rows of the pool.  Compiled once per padded prompt length.
+  ``prefill_bucket > 1`` right-pads prompts to bucket multiples to
+  bound the number of compiles under mixed lengths: causal attention
+  never lets a real token see a pad, and pad rows in the cache sit
+  above ``pos`` where the per-row mask — and the occupant's own later
+  writes — keep them unreachable.  The default is 1 (no padding):
+  padding is mathematically exact but changes the attention reduction's
+  length, which can reassociate float sums and flip a machine-eps
+  argmax tie, and the engine's contract is TOKEN-exactness against
+  ``lm_generate``.
+* **tick** — one token for EVERY slot (``lm_decode_tick`` with the
+  per-row position vector + ``_greedy_token``), caches appended in
+  place per row.  Compiled ONCE for the pool's lifetime: admission and
+  eviction change only the host-side position/token vectors, never the
+  program.
+
+Token-exactness vs ``lm_generate`` row-by-row is a test invariant
+(tests/test_serving.py): both paths run the identical per-row ops — the
+batch dimension and the pool's extra cache rows are masked out with
+exact zeros, so a request decoded in a shared pool emits bit-identical
+tokens to the same request decoded alone.
+
+TP composes exactly as in the closed-batch path: params stay in
+``transformer_lm_specs`` layout, pool caches are sharded ``P(None,
+None, model)`` (each chip holds its local heads' columns), and the
+greedy pick is the (pmax, pmin) pair — the full logits never gather.
+Inactive slots still burn FLOPs (their output is discarded); a
+real-traffic engine keeps the pool near-full, which is the scheduler's
+job.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+
+class DecodeEngine:
+    """Device half of the serving engine: owns the sharded params and the
+    compiled prefill/tick programs; the :class:`~chainermn_tpu.serving
+    .cache_pool.CachePool` owns the buffers the programs thread through.
+
+    ``params`` are GLOBAL arrays in ``init_tp_transformer_lm`` layout;
+    ``mesh`` must carry ``axis_name`` (default: a fresh 1-D mesh over
+    all local devices, like ``make_lm_generator``).
+    """
+
+    def __init__(self, params, pool, mesh=None, axis_name: str = "model",
+                 *, head_dim: int, prefill_bucket: int = 1):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from .._compat import shard_map
+        from ..parallel.decode import _kv_heads
+        from ..parallel.transformer import transformer_lm_specs
+
+        if mesh is None:
+            from ..topology import make_mesh
+            mesh = make_mesh(axis_name=axis_name)
+        self.mesh = mesh
+        self.axis_name = axis_name
+        self.head_dim = int(head_dim)
+        self.pool = pool
+        self.prefill_bucket = max(int(prefill_bucket), 1)
+        self.n_kv_heads = _kv_heads(params, head_dim)
+        self.rope = "pos_embed" not in params
+        self.max_positions = (None if self.rope
+                              else int(params["pos_embed"].shape[0]))
+        self._specs = transformer_lm_specs(params, axis_name)
+        self._params = jax.tree_util.tree_map(
+            lambda x, sp: jax.device_put(x, NamedSharding(mesh, sp)),
+            params, self._specs)
+        self._shard_map = shard_map
+        self._P = P
+        self._cache_specs = [(pool.cache_spec, pool.cache_spec)
+                             for _ in range(pool.n_layers)]
+        self._prefill_progs = {}   # padded prompt length -> compiled fn
+        self._tick_prog = self._build_tick()
+
+    # ---- program builders ----
+    def _build_tick(self):
+        import jax
+
+        from ..parallel.decode import _greedy_token, lm_decode_tick
+
+        axis, head_dim = self.axis_name, self.head_dim
+        P = self._P
+
+        def tick_inner(params, caches, tokens, pos):
+            h_last, new_caches = lm_decode_tick(
+                params, tokens, caches, pos, head_dim=head_dim,
+                axis_name=axis)
+            nxt = _greedy_token(params["embed"], h_last, axis)
+            return nxt, new_caches
+
+        return jax.jit(self._shard_map(
+            tick_inner, mesh=self.mesh,
+            in_specs=(self._specs, self._cache_specs, P(), P()),
+            out_specs=(P(), self._cache_specs)))
+
+    def _build_prefill(self, s_pad: int):
+        import jax
+
+        from ..parallel.decode import _greedy_token, lm_prefill
+
+        axis, head_dim = self.axis_name, self.head_dim
+        P = self._P
+
+        def prefill_inner(params, caches, prompt, s_real, slot):
+            # slab caches sized to the padded prompt only; pads are above
+            # every real row and never read back (causal + pos mask)
+            h, slabs = lm_prefill(params, prompt, s_pad, head_dim=head_dim,
+                                  axis_name=axis)
+            h_last = jax.lax.dynamic_index_in_dim(h, s_real - 1, axis=1,
+                                                  keepdims=False)
+            tok = _greedy_token(params["embed"], h_last, axis)
+            new_caches = []
+            for (kc, vc), (ks, vs) in zip(caches, slabs):
+                start = (slot, 0, 0)
+                new_caches.append(
+                    (jax.lax.dynamic_update_slice(kc, ks.astype(kc.dtype),
+                                                  start),
+                     jax.lax.dynamic_update_slice(vc, vs.astype(vc.dtype),
+                                                  start)))
+            return tok, new_caches
+
+        return jax.jit(self._shard_map(
+            prefill_inner, mesh=self.mesh,
+            in_specs=(self._specs, self._cache_specs, P(), P(), P()),
+            out_specs=(P(), self._cache_specs)))
+
+    # ---- serving faces (host-driven, one call per engine iteration) ----
+    def padded_len(self, s_real: int) -> int:
+        b = self.prefill_bucket
+        return ((int(s_real) + b - 1) // b) * b
+
+    def prefill_into_slot(self, prompt_tokens, slot: int) -> int:
+        """Prefill ``prompt_tokens (S,)`` into ``slot``: writes the K/V
+        slab into the pool's caches, sets ``pool.pos[slot]``, and returns
+        the FIRST generated token (greedy).  One compile per padded
+        length, cached."""
+        import jax.numpy as jnp
+
+        prompt = np.asarray(prompt_tokens, np.int32).reshape(1, -1)
+        s_real = prompt.shape[1]
+        s_pad = self.padded_len(s_real)
+        if s_pad > self.pool.max_total:
+            raise ValueError(
+                f"padded prompt length {s_pad} exceeds pool max_total "
+                f"{self.pool.max_total}")
+        if self.max_positions is not None and s_pad > self.max_positions:
+            raise ValueError(
+                f"padded prompt length {s_pad} exceeds the learned "
+                f"pos_embed max_len {self.max_positions}")
+        if s_pad > s_real:
+            prompt = np.pad(prompt, ((0, 0), (0, s_pad - s_real)))
+        prog = self._prefill_progs.get(s_pad)
+        if prog is None:
+            prog = self._prefill_progs[s_pad] = self._build_prefill(s_pad)
+        tok, self.pool.caches = prog(
+            self._params, self.pool.caches, jnp.asarray(prompt),
+            jnp.int32(s_real), jnp.int32(slot))
+        self.pool.pos[slot] = s_real
+        return int(np.asarray(tok)[0])
+
+    def tick(self, last_tokens: np.ndarray) -> np.ndarray:
+        """One decode tick for ALL slots: consume ``last_tokens
+        (n_slots,)`` at the pool's per-slot positions, append K/V in
+        place, advance every position, and return the next token per
+        slot (the caller keeps only the active rows)."""
+        import jax.numpy as jnp
+
+        tokens = jnp.asarray(np.array(last_tokens, np.int32, copy=True))
+        # COPY at the jax boundary: on CPU ``jnp.asarray`` may zero-copy
+        # alias the host buffer, and dispatch is ASYNC — an in-place
+        # ``pos += 1`` below would race the still-executing tick (seen as
+        # a repeated first token under cold-compile latency).
+        pos = jnp.asarray(np.array(self.pool.pos, np.int32, copy=True))
+        nxt, self.pool.caches = self._tick_prog(
+            self._params, self.pool.caches, tokens, pos)
+        self.pool.pos = self.pool.pos + 1  # out-of-place: never mutate a
+        #                                    buffer jax might still read
+        return np.asarray(nxt)

@@ -1,0 +1,386 @@
+"""Threaded serving API: submit → handle, streaming tokens, metrics.
+
+:class:`ServingEngine` glues the host scheduler, the slot pool, and the
+compiled per-tick programs into the loop a service actually runs::
+
+    eng = ServingEngine(params, head_dim=8, n_slots=4, max_total=64)
+    h = eng.submit([3, 1, 4], max_new_tokens=16,
+                   on_token=lambda tok, req_id: print(tok))
+    eng.start()            # background driver thread (or drive eng.step()
+    h.wait(timeout=30)     # synchronously from a test)
+    print(h.tokens, h.status, h.ttft_ms)
+
+Each ``step()`` is one engine iteration: expire overdue queued work,
+admit (prefill) up to the interleaving bound, run ONE decode tick over
+the pool, stream the new tokens, evict finished sequences.  Requests
+therefore join and leave between ticks — a late submit starts decoding
+as soon as a slot frees, while earlier sequences keep running
+(iteration-level / continuous batching).
+
+Observability (the PR 1/2 substrate, docs/OBSERVABILITY.md):
+
+* per-request PHASE TIMESTAMPS on the handle (``submitted``,
+  ``prefill_start``, ``first_token``, ``finished``) — the span data the
+  integration test asserts on — mirrored into the tracer as
+  ``serving/request/*`` instants (+ a real ``serving/prefill`` /
+  ``serving/tick`` span around each device call) when tracing is on;
+* serving GAUGES through the tracer (``serving/queue_depth``,
+  ``serving/active_slots``, ``serving/tokens_per_sec``) so
+  ``observability.export.write_prometheus_textfile`` scrapes them with
+  everything else, plus :meth:`ServingEngine.metrics` (TTFT p50/p99,
+  per-token latency, slot occupancy) as the ``extra_gauges`` /
+  bench-section payload;
+* optional per-step JSONL via ``observability.export.MetricsWriter``
+  (kind ``serving_step`` records + one ``serving_summary``), the
+  ``scripts/check_perf_regression.py``-gateable stream.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from .. import observability as obs
+from .cache_pool import CachePool
+from .engine import DecodeEngine
+from .scheduler import AdmissionError, Request, Scheduler
+
+
+class RequestHandle:
+    """Caller's view of one submitted request (thread-safe reads)."""
+
+    def __init__(self, req: Request):
+        self._req = req
+
+    @property
+    def id(self) -> int:
+        return self._req.id
+
+    @property
+    def status(self) -> str:
+        return self._req.status
+
+    @property
+    def finish_reason(self) -> Optional[str]:
+        return self._req.finish_reason
+
+    @property
+    def tokens(self) -> List[int]:
+        return list(self._req.tokens)
+
+    @property
+    def timestamps(self) -> Dict[str, float]:
+        return dict(self._req.timestamps)
+
+    @property
+    def ttft_ms(self) -> Optional[float]:
+        ts = self._req.timestamps
+        if "submitted" in ts and "first_token" in ts:
+            return (ts["first_token"] - ts["submitted"]) * 1e3
+        return None
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Block until the request finishes; True iff it did."""
+        return self._req.done_event.wait(timeout)
+
+
+def _percentile(values: List[float], q: float) -> Optional[float]:
+    if not values:
+        return None
+    return float(np.percentile(np.asarray(values, np.float64), q))
+
+
+class ServingEngine:
+    """Continuous-batching inference engine over a slot-managed KV pool.
+
+    ``params``: GLOBAL ``init_tp_transformer_lm`` arrays (greedy decode
+    only — sampling needs per-request rng plumbing; see docs/SERVING.md).
+    ``max_total`` bounds each slot's sequence (prompt + generated); a
+    request that cannot fit is REJECTED at submit (``AdmissionError``,
+    reason ``too_long``), as is any submit while the bounded queue is
+    full (``queue_full``) — backpressure is explicit, never buffered.
+    """
+
+    def __init__(self, params, *, head_dim: int, n_slots: int = 4,
+                 max_total: int = 128, mesh=None, axis_name: str = "model",
+                 queue_capacity: int = 16, max_prefills_per_tick: int = 1,
+                 prefill_bucket: int = 1, metrics_writer=None):
+        from ..parallel.decode import _kv_heads
+
+        n_kv = _kv_heads(params, head_dim)
+        dtype = params["embed"].dtype
+        # pool and engine share one mesh (created here when not given,
+        # like make_lm_generator)
+        if mesh is None:
+            from ..topology import make_mesh
+            mesh = make_mesh(axis_name=axis_name)
+        self.pool = CachePool(n_slots, max_total, len(params["blocks"]),
+                              n_kv * head_dim, dtype, mesh, axis_name)
+        self.engine = DecodeEngine(params, self.pool, mesh, axis_name,
+                                   head_dim=head_dim,
+                                   prefill_bucket=prefill_bucket)
+        self.scheduler = Scheduler(
+            queue_capacity, max_total,
+            max_prefills_per_tick=max_prefills_per_tick,
+            max_positions=self.engine.max_positions)
+        self.metrics_writer = metrics_writer
+        self._running: Dict[int, Request] = {}   # slot -> request
+        self._lock = threading.Lock()            # guards _running + stats
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        # rolling stats (host floats only)
+        self._ttft_ms: List[float] = []
+        self._tok_lat_ms: List[float] = []
+        self._tokens_emitted = 0
+        self._ticks = 0
+        self._occupancy_sum = 0.0
+        self._rejected = 0
+        self._t0 = time.monotonic()
+
+    # ---- submission ----
+    def submit(self, prompt, max_new_tokens: int, *,
+               eos_id: Optional[int] = None,
+               deadline_s: Optional[float] = None,
+               on_token: Optional[Callable[[int, int], None]] = None
+               ) -> RequestHandle:
+        """Enqueue a generation request; raises :class:`AdmissionError`
+        (with ``.reason``) when the queue is full or it can never fit.
+        ``on_token(token, request_id)`` streams each token from the
+        driver thread as it is emitted; ``deadline_s`` is relative to
+        now."""
+        now = time.monotonic()
+        prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
+        req = Request(prompt, max_new_tokens, eos_id=eos_id,
+                      deadline_t=(now + deadline_s
+                                  if deadline_s is not None else None),
+                      on_token=on_token)
+        try:
+            # the PADDED prefill length is what must fit the slot (and
+            # the learned-pos table) — the scheduler only knows raw
+            # lengths, so the bucket-aware check lives here
+            s_pad = self.engine.padded_len(req.prompt_len)
+            cap = self.pool.max_total
+            if self.engine.max_positions is not None:
+                cap = min(cap, self.engine.max_positions)
+            if s_pad > cap:
+                raise AdmissionError(
+                    "too_long",
+                    f"prompt {req.prompt_len} pads to {s_pad} "
+                    f"(prefill_bucket {self.engine.prefill_bucket}), "
+                    f"exceeding per-slot capacity {cap}")
+            self.scheduler.submit(req, now)
+        except AdmissionError:
+            with self._lock:
+                self._rejected += 1
+            raise
+        obs.instant("serving/request/queued", cat="serving", request=req.id)
+        obs.set_gauge("serving/queue_depth", self.scheduler.queue_depth)
+        return RequestHandle(req)
+
+    # ---- the engine iteration ----
+    def step(self) -> Dict[str, float]:
+        """ONE engine iteration: expire → admit/prefill → tick → evict.
+        Returns host-side stats for the iteration (also streamed to the
+        JSONL metrics writer when configured)."""
+        now = time.monotonic()
+        for req in self.scheduler.expire_queued(now):
+            obs.instant("serving/request/expired", cat="serving",
+                        request=req.id)
+
+        # admit up to the interleave bound into free slots
+        for req in self.scheduler.admissions(self.pool.free_count, now):
+            slot = self.pool.acquire()
+            assert slot is not None  # admissions() is bounded by free_count
+            req.slot = slot
+            req.status = "running"
+            req.timestamps["prefill_start"] = now
+            obs.instant("serving/request/prefill", cat="serving",
+                        request=req.id, slot=slot)
+            try:
+                with obs.span("serving/prefill", cat="serving",
+                              request=req.id):
+                    first = self.engine.prefill_into_slot(req.prompt, slot)
+            except Exception as e:
+                # never die holding a slot: a failed prefill (engine bug,
+                # OOM, ...) releases the slot and fails THIS request only
+                # — with start() an escaping exception would kill the
+                # background thread and stall every other request, so the
+                # engine sheds the request and keeps serving
+                self.pool.release(slot)
+                req.finish("error", time.monotonic())
+                obs.instant("serving/request/error", cat="serving",
+                            request=req.id)
+                print(f"chainermn_tpu.serving: prefill of request "
+                      f"{req.id} failed: {e!r}", file=sys.stderr)
+                continue
+            self._emit(req, first, time.monotonic())
+            with self._lock:
+                self._running[slot] = req
+            self._maybe_evict(req, time.monotonic())
+
+        # one decode tick over the pool (skip when nothing is active)
+        with self._lock:
+            active = dict(self._running)
+        if active:
+            tokens = np.zeros(self.pool.n_slots, np.int32)
+            for slot, req in active.items():
+                tokens[slot] = req.tokens[-1]
+            t_tick = time.monotonic()
+            with obs.span("serving/tick", cat="serving",
+                          active=len(active)):
+                nxt = self.engine.tick(tokens)
+            dt_ms = (time.monotonic() - t_tick) * 1e3
+            now = time.monotonic()
+            for slot, req in active.items():
+                self._emit(req, int(nxt[slot]), now)
+                self._tok_lat_ms.append(dt_ms / max(len(active), 1))
+                self._maybe_evict(req, now)
+
+        with self._lock:
+            self._ticks += 1
+            self._occupancy_sum += self.pool.busy_count / self.pool.n_slots
+            stats = {
+                "queue_depth": float(self.scheduler.queue_depth),
+                "active_slots": float(self.pool.busy_count),
+                "tokens_emitted": float(self._tokens_emitted),
+            }
+        obs.set_gauge("serving/queue_depth", stats["queue_depth"])
+        obs.set_gauge("serving/active_slots", stats["active_slots"])
+        el = time.monotonic() - self._t0
+        if el > 0:
+            obs.set_gauge("serving/tokens_per_sec",
+                          self._tokens_emitted / el)
+        if self.metrics_writer is not None:
+            self.metrics_writer.write(
+                {f"serving/{k}": v for k, v in stats.items()},
+                kind="serving_step")
+        return stats
+
+    def _emit(self, req: Request, token: int, now: float) -> None:
+        req.tokens.append(int(token))
+        if "first_token" not in req.timestamps:
+            req.timestamps["first_token"] = now
+            ttft = (now - req.timestamps["submitted"]) * 1e3
+            with self._lock:
+                self._ttft_ms.append(ttft)
+            obs.instant("serving/request/first_token", cat="serving",
+                        request=req.id)
+        with self._lock:
+            self._tokens_emitted += 1
+        obs.add_counter("serving/tokens_total", 1)
+        if req.on_token is not None:
+            req.on_token(int(token), req.id)
+
+    def _maybe_evict(self, req: Request, now: float) -> None:
+        reason = self.scheduler.eviction_reason(req, now)
+        if reason is None:
+            return
+        slot = req.slot
+        req.finish(reason, now)
+        with self._lock:
+            self._running.pop(slot, None)
+        self.pool.release(slot)
+        obs.instant("serving/request/complete", cat="serving",
+                    request=req.id, reason=reason)
+
+    # ---- driving ----
+    def run(self, steps_budget: Optional[int] = None,
+            drain: bool = True) -> int:
+        """Drive ``step()`` until the engine is idle (queue empty, no
+        active slots) or ``steps_budget`` iterations elapse; returns the
+        number of iterations run.  ``drain=False`` stops at the budget
+        even with work pending (the CLI's ``--steps-budget``)."""
+        n = 0
+        while not self._stop.is_set():
+            if steps_budget is not None and n >= steps_budget:
+                break
+            busy = (self.scheduler.queue_depth > 0
+                    or self.pool.busy_count > 0)
+            if not busy:
+                if drain:
+                    break
+                time.sleep(0.001)
+                continue
+            self.step()
+            n += 1
+        return n
+
+    def start(self) -> None:
+        """Background driver thread (idles when there is no work)."""
+        if self._thread is not None:
+            return
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.is_set():
+                if (self.scheduler.queue_depth == 0
+                        and self.pool.busy_count == 0):
+                    time.sleep(0.002)
+                    continue
+                self.step()
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="serving-engine")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+
+    # ---- metrics ----
+    def reset_stats(self) -> None:
+        """Zero the rolling serving stats and restart the throughput
+        clock — call after warm-up (compiles) so steady-state numbers
+        don't absorb one-off costs (bench.py's serving section does)."""
+        with self._lock:
+            self._t0 = time.monotonic()
+            self._ttft_ms = []
+            self._tok_lat_ms = []
+            self._tokens_emitted = 0
+            self._ticks = 0
+            self._occupancy_sum = 0.0
+            self._rejected = 0
+
+    def metrics(self) -> Dict[str, float]:
+        """Host-side serving summary (the Prometheus ``extra_gauges`` /
+        bench-section payload).  ``*_ms`` keys are lower-is-better under
+        the regression gate's direction inference."""
+        with self._lock:
+            el = max(time.monotonic() - self._t0, 1e-9)
+            out = {
+                "serving/tokens_per_sec": self._tokens_emitted / el,
+                "serving/tokens_total": float(self._tokens_emitted),
+                "serving/ticks": float(self._ticks),
+                "serving/queue_depth": float(self.scheduler.queue_depth),
+                "serving/active_slots": float(self.pool.busy_count),
+                "serving/rejected_total": float(self._rejected),
+                "serving/slot_occupancy_pct": 100.0 * (
+                    self._occupancy_sum / self._ticks if self._ticks
+                    else 0.0),
+            }
+            for name, vals in (("ttft", self._ttft_ms),
+                               ("token_latency", self._tok_lat_ms)):
+                p50 = _percentile(vals, 50)
+                p99 = _percentile(vals, 99)
+                if p50 is not None:
+                    out[f"serving/{name}_p50_ms"] = p50
+                    out[f"serving/{name}_p99_ms"] = p99
+        return out
+
+    def write_prometheus(self, path: str) -> str:
+        """Atomic Prometheus textfile: tracer counters/gauges + the
+        serving summary as extra gauges."""
+        from ..observability.export import write_prometheus_textfile
+        return write_prometheus_textfile(path, extra_gauges=self.metrics())
+
+    def finalize_metrics(self) -> None:
+        """Append the ``serving_summary`` JSONL record (clean-exit
+        roll-up) when a metrics writer is configured."""
+        if self.metrics_writer is not None:
+            self.metrics_writer.write(self.metrics(), kind="serving_summary")

@@ -56,11 +56,13 @@ _SKIP = re.compile(
     r"straggler_rank|merged_ranks|expected_ranks)($|/)")
 
 #: Lower-is-better key fingerprints (everything else: higher is better).
-#: slowdown/imbalance/drift come from the skew report; anomaly counts and
-#: dropped-event tallies are failure tallies — more is worse.
+#: slowdown/imbalance/drift come from the skew report; anomaly counts,
+#: dropped-event and rejected-request tallies are failure tallies — more
+#: is worse (rejected: the serving engine's backpressure counter).
 _LOWER = re.compile(
     r"(time|_ms|ms_|/ms$|^ms$|latency|seconds|_s$|/s$|bytes|loss|"
-    r"step_ms|gap|slowdown|imbalance|drift|anomal|dropped|findings)",
+    r"step_ms|gap|slowdown|imbalance|drift|anomal|dropped|findings|"
+    r"rejected)",
     re.IGNORECASE)
 
 

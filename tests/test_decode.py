@@ -100,6 +100,24 @@ def test_tp_sharding_does_not_change_tokens(devices):
     np.testing.assert_array_equal(outs[1], outs[4])
 
 
+def test_sampling_without_rng_raises(devices):
+    """Determinism-trap regression: temperature > 0 with rng=None used to
+    fall back silently to PRNGKey(0), so every default-rng call sampled
+    the IDENTICAL token sequence.  The contract is now explicit: sampling
+    requires a key; greedy (temperature=0) still runs without one."""
+    params = init_tp_transformer_lm(
+        jax.random.PRNGKey(2), VOCAB, D, HEADS, LAYERS, max_len=64)
+    prompt = np.zeros((1, 4), np.int32)
+    mesh = mn.make_nd_mesh(("data", "model"), (1, 2), devices[:2])
+    gen = make_lm_generator(mesh, "model", head_dim=HEAD_DIM,
+                            max_new_tokens=4, temperature=1.0)
+    with pytest.raises(ValueError, match="explicit rng"):
+        gen(params, prompt)
+    greedy = make_lm_generator(mesh, "model", head_dim=HEAD_DIM,
+                               max_new_tokens=4)
+    assert np.asarray(greedy(params, prompt)).shape == (1, 4)
+
+
 def test_sampling_is_reproducible_and_varied(devices):
     params = init_tp_transformer_lm(
         jax.random.PRNGKey(2), VOCAB, D, HEADS, LAYERS, max_len=64)

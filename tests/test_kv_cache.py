@@ -115,6 +115,74 @@ def test_rows_not_dividing_8_falls_back():
         cache_append(kc, kc, kn, kn, 6, axis=1, impl="pallas")
 
 
+class TestPerRowPositions:
+    """Per-row position vectors (the serving pool's ragged tick): row b
+    writes at pos[b].  Oracle: stacked per-row dynamic_update_slice."""
+
+    def _oracle(self, kc, kn, pos, axis):
+        rows = [jax.lax.dynamic_update_slice_in_dim(
+            kc[b], kn[b], int(pos[b]), axis - 1)
+            for b in range(kc.shape[0])]
+        return np.stack([np.asarray(r) for r in rows])
+
+    def test_vector_pos_matches_per_row_dus(self):
+        b, s, d = 4, 32, 16
+        kc, vc = _mk((b, s, d), jnp.float32, 30), _mk((b, s, d),
+                                                      jnp.float32, 31)
+        kn, vn = _mk((b, 1, d), jnp.float32, 32), _mk((b, 1, d),
+                                                      jnp.float32, 33)
+        pos = jnp.asarray([0, 5, 31, 17], jnp.int32)  # ragged, unaligned
+        got_k, got_v = cache_append(kc, vc, kn, vn, pos, axis=1)
+        np.testing.assert_array_equal(np.asarray(got_k),
+                                      self._oracle(kc, kn, pos, 1))
+        np.testing.assert_array_equal(np.asarray(got_v),
+                                      self._oracle(vc, vn, pos, 1))
+
+    def test_vector_pos_under_jit_with_traced_positions(self):
+        b, s, d = 3, 16, 8
+        kc = _mk((b, s, d), jnp.bfloat16, 34)
+        kn = _mk((b, 1, d), jnp.bfloat16, 35)
+
+        @jax.jit
+        def go(pos):
+            return cache_append(kc, kc, kn, kn, pos, axis=1)[0]
+
+        pos = jnp.asarray([2, 9, 15], jnp.int32)
+        np.testing.assert_array_equal(np.asarray(go(pos)),
+                                      self._oracle(kc, kn, pos, 1))
+        assert go(pos).dtype == jnp.bfloat16
+
+    def test_all_equal_vector_matches_scalar(self):
+        b, s, d = 2, 32, 16
+        kc = _mk((b, s, d), jnp.float32, 36)
+        kn = _mk((b, 1, d), jnp.float32, 37)
+        vec, _ = cache_append(kc, kc, kn, kn,
+                              jnp.full((b,), 11, jnp.int32), axis=1)
+        sca, _ = cache_append(kc, kc, kn, kn, 11, axis=1)
+        np.testing.assert_array_equal(np.asarray(vec), np.asarray(sca))
+
+    def test_multi_row_writes_per_row(self):
+        # each row writes a 2-row slab at its own position
+        b, s, r, d = 2, 24, 2, 8
+        kc = _mk((b, s, d), jnp.float32, 38)
+        kn = _mk((b, r, d), jnp.float32, 39)
+        pos = jnp.asarray([3, 20], jnp.int32)
+        got, _ = cache_append(kc, kc, kn, kn, pos, axis=1)
+        np.testing.assert_array_equal(np.asarray(got),
+                                      self._oracle(kc, kn, pos, 1))
+
+    def test_vector_pos_rejections(self):
+        kc = jnp.zeros((2, 32, 16))
+        kn = jnp.ones((2, 1, 16))
+        with pytest.raises(ValueError, match="scalar pos only"):
+            cache_append(kc, kc, kn, kn, jnp.asarray([1, 2]), axis=1,
+                         impl="pallas")
+        with pytest.raises(ValueError, match="length"):
+            cache_append(kc, kc, kn, kn, jnp.asarray([1, 2, 3]), axis=1)
+        with pytest.raises(ValueError, match="row axis"):
+            cache_append(kc.T, kc.T, kn, kn, jnp.asarray([1, 2]), axis=0)
+
+
 def test_pallas_on_non_tpu_backend_raises_descriptive_error():
     # A VALID envelope forced onto compiled Pallas off-chip must fail at
     # dispatch with an actionable message, not deep in Mosaic lowering.

@@ -1,0 +1,490 @@
+"""Continuous-batching serving engine tests.
+
+Three layers, cheapest first:
+
+* **Policy invariants** (jax-free): the slot allocator and scheduler are
+  pure host Python, so their invariants — no slot leak, FIFO admission,
+  reject-with-reason backpressure, deadline expiry — are fuzzed directly
+  with a simulated engine loop: hundreds of random arrival/eviction
+  sequences per test, no compile anywhere.
+* **Engine integration** (the acceptance gate): a 4-slot pool serving 8
+  staggered requests must (a) start decoding a late-arriving request
+  BEFORE the first batch drains — iteration-level batching, asserted on
+  the per-request span timestamps — and (b) emit TOKEN-EXACT output vs
+  running each request alone through ``lm_generate`` (which doubles as
+  the no-cross-talk oracle: slots share every tick's batch and are
+  recycled between requests, so any leakage between sequences breaks
+  exactness).  The serving gauges must reach the Prometheus textfile
+  and the bench-shaped serving section must be ACCEPTED by
+  ``scripts/check_perf_regression.py``.
+* **CLI smoke**: ``chainermn_tpu.serve`` in-process with a tiny config —
+  summary JSON on stdout, schema-valid metrics JSONL, exit 0.
+"""
+
+import json
+import os
+import random
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from chainermn_tpu.serving import AdmissionError, Request, Scheduler
+from chainermn_tpu.serving.cache_pool import SlotAllocator
+
+ROOT = os.path.join(os.path.dirname(__file__), "..")
+
+VOCAB, D, HEADS, LAYERS = 32, 16, 4, 2
+HEAD_DIM = D // HEADS
+
+
+# ---------------------------------------------------------------------------
+# policy invariants (no jax)
+# ---------------------------------------------------------------------------
+
+def test_slot_allocator_invariants():
+    alloc = SlotAllocator(3)
+    a, b = alloc.acquire(), alloc.acquire()
+    assert (a, b) == (0, 1)
+    alloc.release(a)
+    assert alloc.acquire() == 0          # recycled, lowest-first
+    assert alloc.acquire() == 2
+    assert alloc.acquire() is None       # saturated
+    with pytest.raises(ValueError, match="not busy"):
+        alloc.release(1)                 # double release
+        alloc.release(1)
+    alloc.check_invariants()
+
+
+def test_scheduler_backpressure_and_reasons():
+    sched = Scheduler(queue_capacity=2, slot_capacity=16)
+    now = 0.0
+    sched.submit(Request([1, 2], 4), now)
+    sched.submit(Request([1, 2], 4), now)
+    with pytest.raises(AdmissionError) as e:
+        sched.submit(Request([1, 2], 4), now)
+    assert e.value.reason == "queue_full"
+    with pytest.raises(AdmissionError) as e:
+        sched.submit(Request(list(range(10)), 10), now)  # 20 > 16
+    assert e.value.reason == "too_long"
+    # the learned-pos table bound tightens slot capacity
+    tight = Scheduler(queue_capacity=2, slot_capacity=64, max_positions=8)
+    with pytest.raises(AdmissionError) as e:
+        tight.submit(Request([1, 2, 3, 4], 6), now)      # 10 > 8
+    assert e.value.reason == "too_long"
+
+
+def test_scheduler_fifo_admission_and_interleave_bound():
+    sched = Scheduler(queue_capacity=8, slot_capacity=64,
+                      max_prefills_per_tick=2)
+    reqs = [Request([1], 2) for _ in range(5)]
+    for r in reqs:
+        sched.submit(r, 0.0)
+    # bounded by max_prefills_per_tick even with more slots free
+    first = sched.admissions(free_slots=4, now=0.0)
+    assert [r.id for r in first] == [reqs[0].id, reqs[1].id]
+    # bounded by free slots even with prefill budget left
+    second = sched.admissions(free_slots=1, now=0.0)
+    assert [r.id for r in second] == [reqs[2].id]
+
+
+def test_scheduler_deadline_expiry_and_eviction_reasons():
+    sched = Scheduler(queue_capacity=4, slot_capacity=64)
+    late = Request([1], 4, deadline_t=1.0)
+    ok = Request([1], 4)
+    sched.submit(late, 0.0)
+    sched.submit(ok, 0.0)
+    expired = sched.expire_queued(now=2.0)
+    assert expired == [late] and late.status == "evicted" \
+        and late.finish_reason == "deadline"
+    assert [r.id for r in sched.admissions(4, 2.0)] == [ok.id]
+    # eviction precedence: eos > max_tokens > deadline
+    r = Request([1], 2, eos_id=9, deadline_t=10.0)
+    r.tokens = [5]
+    assert sched.eviction_reason(r, 0.0) is None
+    r.tokens = [5, 9]
+    assert sched.eviction_reason(r, 99.0) == "eos"
+    r2 = Request([1], 2)
+    r2.tokens = [5, 6]
+    assert sched.eviction_reason(r2, 0.0) == "max_tokens"
+    r3 = Request([1], 8, deadline_t=1.0)
+    r3.tokens = [5]
+    assert sched.eviction_reason(r3, 2.0) == "deadline"
+
+
+def test_fuzzed_arrival_eviction_no_leak_fifo_under_backpressure():
+    """Simulated engine loop, no devices: random arrivals, lengths and
+    deadlines against a 4-slot pool.  Invariants checked EVERY step:
+    free+busy partitions the slots, admission is FIFO among accepted
+    requests, the queue never exceeds capacity, rejections happen only
+    at capacity, and every accepted request terminates with a legal
+    reason."""
+    rng = random.Random(0)
+    for trial in range(20):
+        n_slots, cap = 4, 3
+        sched = Scheduler(queue_capacity=cap, slot_capacity=32,
+                          max_prefills_per_tick=rng.choice([1, 2]))
+        alloc = SlotAllocator(n_slots)
+        running = {}          # slot -> (req, remaining_ticks)
+        accepted, admitted, finished = [], [], []
+        now = 0.0
+        for step in range(120):
+            now += 1.0
+            # random arrivals
+            for _ in range(rng.randrange(3)):
+                req = Request([1] * rng.randint(1, 8),
+                              rng.randint(1, 6),
+                              eos_id=7 if rng.random() < 0.3 else None,
+                              deadline_t=(now + rng.randint(1, 30)
+                                          if rng.random() < 0.3 else None))
+                try:
+                    sched.submit(req, now)
+                except AdmissionError as e:
+                    assert e.reason == "queue_full"
+                    assert sched.queue_depth == cap  # only reject at cap
+                else:
+                    accepted.append(req)
+            for req in sched.expire_queued(now):
+                finished.append(req)
+                assert req.finish_reason == "deadline"
+            for req in sched.admissions(alloc.free_count, now):
+                slot = alloc.acquire()
+                assert slot is not None
+                admitted.append(req)
+                running[slot] = (req, rng.randint(1, req.max_new_tokens))
+            # decode tick: emit one token per active slot (the last
+            # simulated token is 7, tripping eos for requests that set it)
+            for slot in list(running):
+                req, rem = running[slot]
+                req.tokens.append(0 if rem > 1 else 7)
+                running[slot] = (req, rem - 1)
+                reason = sched.eviction_reason(req, now)
+                if reason:
+                    req.finish(reason, now)
+                    finished.append(req)
+                    del running[slot]
+                    alloc.release(slot)
+            alloc.check_invariants()
+            assert alloc.busy_count == len(running)
+            assert sched.queue_depth <= cap
+        # FIFO: admission order is a subsequence-respecting prefix order
+        order = {r.id: i for i, r in enumerate(accepted)}
+        assert [order[r.id] for r in admitted] == sorted(
+            order[r.id] for r in admitted)
+        for req in finished:
+            assert req.finish_reason in ("eos", "max_tokens", "deadline")
+            assert req.done_event.is_set()
+
+
+# ---------------------------------------------------------------------------
+# engine integration (devices)
+# ---------------------------------------------------------------------------
+
+def _params(pos_impl="learned", n_kv_heads=None, seed=0):
+    import jax
+    from chainermn_tpu.parallel import init_tp_transformer_lm
+
+    return init_tp_transformer_lm(
+        jax.random.PRNGKey(seed), VOCAB, D, HEADS, LAYERS, max_len=64,
+        pos_impl=pos_impl, n_kv_heads=n_kv_heads)
+
+
+def _mesh(devices, tp):
+    import chainermn_tpu as mn
+
+    return mn.make_nd_mesh(("model",), (tp,), devices[:tp])
+
+
+def _oracle(params, mesh, prompt, max_new):
+    """Each request ALONE through the closed-batch generator (greedy
+    tokens are max_new-invariant prefixes, so one program serves every
+    request length)."""
+    from chainermn_tpu.parallel import make_lm_generator
+
+    gen = make_lm_generator(mesh, "model", head_dim=HEAD_DIM,
+                            max_new_tokens=max_new)
+    return np.asarray(gen(params, np.asarray(prompt)[None]))[0]
+
+
+def test_iteration_level_batching_end_to_end(devices, tmp_path):
+    """THE acceptance test: 4-slot pool, 8 staggered requests; a late
+    arrival starts decoding before the first batch drains; outputs are
+    token-exact vs lm_generate alone (= no cross-talk through the shared
+    pool / recycled slots); gauges reach Prometheus and the serving
+    bench section passes the regression gate."""
+    from chainermn_tpu import observability as obs
+    from chainermn_tpu.serving import ServingEngine
+
+    params = _params()
+    mesh = _mesh(devices, 2)
+    eng = ServingEngine(params, head_dim=HEAD_DIM, n_slots=4, max_total=32,
+                        mesh=mesh, queue_capacity=8,
+                        max_prefills_per_tick=2)
+    obs.reset()
+    obs.enable()
+    try:
+        rng = np.random.RandomState(1)
+        prompts = [rng.randint(0, VOCAB, 6).astype(np.int32)
+                   for _ in range(8)]
+        # request 0 runs LONG; its wave-mates finish early, freeing slots
+        # for the late wave while 0 is still decoding
+        max_new = [12, 4, 4, 4, 6, 6, 6, 6]
+        streamed = {}
+        handles = [eng.submit(prompts[i], max_new[i],
+                              on_token=lambda t, rid: streamed.setdefault(
+                                  rid, []).append(t))
+                   for i in range(4)]
+        for _ in range(2):
+            eng.step()
+        handles += [eng.submit(prompts[i], max_new[i]) for i in range(4, 8)]
+        eng.run(steps_budget=200)
+    finally:
+        obs.disable()
+
+    # every request completed by length
+    for h in handles:
+        assert h.status == "done", (h.id, h.status, h.finish_reason)
+        assert h.finish_reason == "max_tokens"
+
+    # iteration-level batching: request 4 decoded its first token BEFORE
+    # the longest first-wave request finished (span timestamps)
+    t_first_late = handles[4].timestamps["first_token"]
+    t_drain = handles[0].timestamps["finished"]
+    assert t_first_late < t_drain, (t_first_late, t_drain)
+    for h in handles:
+        ts = h.timestamps
+        assert ts["submitted"] <= ts["prefill_start"] \
+            <= ts["first_token"] <= ts["finished"]
+
+    # token-exact vs each request alone through lm_generate
+    oracle12 = {i: _oracle(params, mesh, prompts[i], 12) for i in range(8)}
+    for i, h in enumerate(handles):
+        want = oracle12[i][: max_new[i]].tolist()
+        assert h.tokens == want, (i, h.tokens, want)
+    # streaming callbacks saw exactly the same tokens, in order
+    for i in range(4):
+        assert streamed[handles[i].id] == handles[i].tokens
+
+    # tracer carries the per-request serving instants + tick spans
+    names = {ev["name"] for ev in obs.get_tracer().events()}
+    for expected in ("serving/request/queued", "serving/request/prefill",
+                     "serving/request/first_token",
+                     "serving/request/complete", "serving/tick",
+                     "serving/prefill"):
+        assert expected in names, (expected, sorted(names)[:30])
+
+    # Prometheus textfile carries the serving gauges
+    prom = eng.write_prometheus(str(tmp_path / "serving.prom"))
+    assert "chainermn_tpu_serving_tokens_per_sec" in prom
+    assert "chainermn_tpu_serving_ttft_p50_ms" in prom
+    assert "chainermn_tpu_serving_slot_occupancy_pct" in prom
+
+    # bench-shaped serving section round-trips the regression gate
+    m = eng.metrics()
+    section = {"serving": {"load_test": {
+        "tokens_per_sec": m["serving/tokens_per_sec"],
+        "ttft_p50_ms": m["serving/ttft_p50_ms"],
+        "ttft_p99_ms": m["serving/ttft_p99_ms"],
+        "slot_occupancy_pct": m["serving/slot_occupancy_pct"],
+    }}}
+    path = tmp_path / "serving_bench.json"
+    path.write_text(json.dumps(section))
+    gate = subprocess.run(
+        [sys.executable,
+         os.path.join(ROOT, "scripts", "check_perf_regression.py"),
+         str(path), str(path)],
+        capture_output=True, text=True, timeout=120)
+    assert gate.returncode == 0, (gate.stdout, gate.stderr)
+    assert "0 regression(s)" in gate.stdout
+
+
+@pytest.mark.parametrize("pos_impl,n_kv_heads", [("rope", 2)])
+def test_rope_gqa_exactness_with_recycled_slots(devices, pos_impl,
+                                                n_kv_heads):
+    """Per-row RoPE + GQA through the pool, with slot RECYCLING: more
+    requests than slots at mixed prompt lengths, so late requests decode
+    in slots still holding an earlier sequence's stale K/V — exactness
+    proves the per-slot masks keep it unreachable."""
+    from chainermn_tpu.serving import ServingEngine
+
+    params = _params(pos_impl=pos_impl, n_kv_heads=n_kv_heads, seed=3)
+    mesh = _mesh(devices, 2)
+    eng = ServingEngine(params, head_dim=HEAD_DIM, n_slots=2, max_total=32,
+                        mesh=mesh, queue_capacity=8)
+    rng = np.random.RandomState(7)
+    prompts = [rng.randint(0, VOCAB, rng.choice([4, 6])).astype(np.int32)
+               for _ in range(5)]
+    handles = [eng.submit(p, 5) for p in prompts]
+    eng.run(steps_budget=200)
+    for p, h in zip(prompts, handles):
+        assert h.status == "done"
+        assert h.tokens == _oracle(params, mesh, p, 5).tolist(), h.id
+
+
+def test_eos_and_deadline_eviction_live(devices):
+    """EOS eviction against the real engine (eos learned from the oracle
+    so it is guaranteed to be emitted), and deadline eviction of a
+    RUNNING request (deadline forced into the past between ticks)."""
+    from chainermn_tpu.serving import ServingEngine
+
+    params = _params(seed=5)
+    mesh = _mesh(devices, 1)
+    eng = ServingEngine(params, head_dim=HEAD_DIM, n_slots=2, max_total=32,
+                        mesh=mesh)
+    prompt = np.arange(5, dtype=np.int32) % VOCAB
+    want = _oracle(params, mesh, prompt, 6).tolist()
+    h = eng.submit(prompt, 6, eos_id=want[2])
+    eng.run(steps_budget=50)
+    assert h.status == "done" and h.finish_reason == "eos"
+    assert h.tokens == want[:3]          # eos token included, then stop
+    assert eng.pool.busy_count == 0      # slot released
+
+    h2 = eng.submit(prompt, 27, deadline_s=3600)    # 5 + 27 = max_total
+    eng.step()                           # admitted + first token
+    assert h2.status == "running"
+    h2._req.deadline_t = time.monotonic() - 1.0
+    eng.step()
+    assert h2.status == "evicted" and h2.finish_reason == "deadline"
+    assert eng.pool.busy_count == 0
+
+
+def test_live_backpressure_and_too_long(devices):
+    from chainermn_tpu.serving import ServingEngine
+
+    params = _params(seed=6)
+    eng = ServingEngine(params, head_dim=HEAD_DIM, n_slots=1, max_total=16,
+                        mesh=_mesh(devices, 1), queue_capacity=1)
+    with pytest.raises(AdmissionError) as e:
+        eng.submit(np.zeros(10, np.int32), 10)       # 20 > 16
+    assert e.value.reason == "too_long"
+    eng.submit(np.zeros(4, np.int32), 2)
+    with pytest.raises(AdmissionError) as e:
+        eng.submit(np.zeros(4, np.int32), 2)         # queue at capacity
+    assert e.value.reason == "queue_full"
+    assert eng.metrics()["serving/rejected_total"] == 2.0
+    eng.run(steps_budget=20)                         # drains cleanly
+
+    # deadline_s=0.0 means ALREADY expired, not "no deadline"
+    h = eng.submit(np.zeros(4, np.int32), 4, deadline_s=0.0)
+    eng.step()
+    assert h.status == "evicted" and h.finish_reason == "deadline"
+
+
+def test_prefill_bucket_padding_counts_against_capacity(devices):
+    """Admission must reject on the PADDED prompt length: a 13-token
+    prompt under prefill_bucket=8 pads to 16, which cannot fit a
+    max_total=14 slot even though 13 + 1 would."""
+    from chainermn_tpu.serving import ServingEngine
+
+    params = _params(seed=6)
+    eng = ServingEngine(params, head_dim=HEAD_DIM, n_slots=1, max_total=14,
+                        mesh=_mesh(devices, 1), prefill_bucket=8)
+    with pytest.raises(AdmissionError) as e:
+        eng.submit(np.zeros(13, np.int32), 1)
+    assert e.value.reason == "too_long" and "pads to 16" in str(e.value)
+    # a 5-token prompt pads to 8 and fits; exactness holds through the
+    # padded prefill (causal attention never reads a pad)
+    prompt = (np.arange(5) % VOCAB).astype(np.int32)
+    h = eng.submit(prompt, 4)
+    eng.run(steps_budget=20)
+    assert h.status == "done"
+    assert h.tokens == _oracle(params, _mesh(devices, 1), prompt, 4).tolist()
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+def test_serve_cli_inprocess(tmp_path, capsys):
+    """``python -m chainermn_tpu.serve`` smoke, in-process (the 8-device
+    CPU env is already up): exits 0, prints ONE summary JSON line on
+    stdout, and writes a schema-valid metrics JSONL stream."""
+    from chainermn_tpu import serve
+    from chainermn_tpu.observability.export import read_metrics_jsonl
+
+    metrics = tmp_path / "serve_metrics.jsonl"
+    rc = serve.main([
+        "--tp", "1", "--vocab", "32", "--d-model", "16", "--n-heads", "2",
+        "--n-layers", "1", "--seq-len", "12", "--train-steps", "2",
+        "--requests", "3", "--prompt-len", "4", "--max-new-tokens", "3",
+        "--n-slots", "2", "--steps-budget", "40",
+        "--metrics-out", str(metrics)])
+    assert rc == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    summary = json.loads(out[-1])
+    assert summary["schema"] == "chainermn_tpu.serve.v1"
+    assert len(summary["requests"]) == 3
+    for row in summary["requests"]:
+        assert row["status"] == "done", row
+    assert summary["metrics"]["serving/tokens_total"] == 9.0
+    # strict schema validation of the stream + the summary roll-up
+    records = read_metrics_jsonl(str(metrics), strict=True)
+    kinds = [r["kind"] for r in records]
+    assert "serving_step" in kinds and kinds[-1] == "serving_summary"
+    assert records[-1]["serving/tokens_per_sec"] > 0
+
+
+@pytest.mark.slow
+def test_bench_serving_section_shape_and_gate(tmp_path):
+    """The REAL bench section: offered-load sweep runs, reports the
+    documented keys, and its JSON round-trips the regression gate with
+    the intended directions (ttft/latency/rejected lower-is-better,
+    steps skipped as bookkeeping)."""
+    sys.path.insert(0, ROOT)
+    try:
+        import bench
+        section = bench.bench_serving()
+    finally:
+        sys.path.remove(ROOT)
+    for point in ("load_high", "load_low"):
+        row = section[point]
+        for key in ("tokens_per_sec", "ttft_p50_ms", "ttft_p99_ms",
+                    "token_latency_p50_ms", "slot_occupancy_pct",
+                    "rejected", "steps"):
+            assert key in row, (point, key, row)
+        assert row["tokens_per_sec"] > 0
+    path = tmp_path / "serving.json"
+    path.write_text(json.dumps({"serving": section}))
+    gate = subprocess.run(
+        [sys.executable,
+         os.path.join(ROOT, "scripts", "check_perf_regression.py"),
+         str(path), str(path), "--json"],
+        capture_output=True, text=True, timeout=120)
+    assert gate.returncode == 0, (gate.stdout, gate.stderr)
+    verdict = json.loads(gate.stdout)
+    assert verdict["ok"] and verdict["compared"] >= 10
+    # direction inference: the gate must treat these as lower-is-better
+    sys.path.insert(0, ROOT)
+    try:
+        from scripts.check_perf_regression import lower_is_better
+    finally:
+        sys.path.remove(ROOT)
+    for key in ("serving/load_high/ttft_p99_ms",
+                "serving/load_low/token_latency_p50_ms",
+                "serving/load_high/rejected"):
+        assert lower_is_better(key), key
+    assert not lower_is_better("serving/load_high/tokens_per_sec")
+    assert not lower_is_better("serving/load_high/slot_occupancy_pct")
+
+
+@pytest.mark.slow
+def test_serve_cli_subprocess(tmp_path):
+    """The real ``python -m chainermn_tpu.serve`` entry point in a fresh
+    interpreter (test_examples_cli.py style), with metrics + prom out."""
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env["JAX_PLATFORMS"] = "cpu"
+    metrics = tmp_path / "m.jsonl"
+    prom = tmp_path / "m.prom"
+    out = subprocess.run(
+        [sys.executable, "-m", "chainermn_tpu.serve", "--devices", "8",
+         "--tp", "2", "--train-steps", "5", "--requests", "5",
+         "--max-new-tokens", "4", "--steps-budget", "60",
+         "--metrics-out", str(metrics), "--prom-out", str(prom)],
+        capture_output=True, text=True, timeout=600, env=env, cwd=ROOT)
+    assert out.returncode == 0, out.stderr[-2000:]
+    summary = json.loads(out.stdout.strip().splitlines()[-1])
+    assert summary["schema"] == "chainermn_tpu.serve.v1"
+    assert prom.read_text().count("chainermn_tpu_serving_") >= 5
