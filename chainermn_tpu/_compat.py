@@ -94,6 +94,66 @@ def shape_dtype_struct(shape, dtype, vma=None):
     return jax.ShapeDtypeStruct(shape, dtype)
 
 
+_NATIVE_TYPEOF = getattr(jax, "typeof", None)
+
+
+def typeof(x):
+    """``jax.typeof`` (current JAX) / ``jax.core.get_aval`` (0.4.x).
+
+    Legacy avals carry no ``vma`` field, which is exactly right: callers
+    read ``getattr(typeof(x), "vma", frozenset())`` and take their
+    no-vma-tracking fallback path."""
+    if _NATIVE_TYPEOF is not None:
+        return _NATIVE_TYPEOF(x)
+    return jax.core.get_aval(x)
+
+
+def _diffable_optimization_barrier():
+    """Whether this jax can differentiate ``optimization_barrier``
+    (rule added after 0.4.37); probed once, lazily, with a scalar jvp."""
+    global _OPT_BARRIER_DIFFABLE
+    if _OPT_BARRIER_DIFFABLE is None:
+        try:
+            jax.jvp(jax.lax.optimization_barrier, (1.0,), (1.0,))
+            _OPT_BARRIER_DIFFABLE = True
+        except NotImplementedError:
+            _OPT_BARRIER_DIFFABLE = False
+    return _OPT_BARRIER_DIFFABLE
+
+
+_OPT_BARRIER_DIFFABLE = None
+_BARRIER_VJP = None
+
+
+def optimization_barrier(args):
+    """Differentiable ``jax.lax.optimization_barrier``.
+
+    Native where the differentiation rule exists; on legacy jax (0.4.37:
+    ``NotImplementedError: Differentiation rule for 'optimization_barrier'``)
+    a ``custom_vjp`` wrapper with the same semantics — value identity,
+    scheduling edge on the forward, and the cotangents barriered too so
+    the BACKWARD pass keeps the ordering edge (the reference
+    pseudo_connect's whole point was backward ordering)."""
+    if _diffable_optimization_barrier():
+        return jax.lax.optimization_barrier(args)
+
+    global _BARRIER_VJP
+    if _BARRIER_VJP is None:
+        @jax.custom_vjp
+        def barrier(a):
+            return jax.lax.optimization_barrier(a)
+
+        def fwd(a):
+            return barrier(a), None
+
+        def bwd(_, ct):
+            return (jax.lax.optimization_barrier(ct),)
+
+        barrier.defvjp(fwd, bwd)
+        _BARRIER_VJP = barrier
+    return _BARRIER_VJP(args)
+
+
 def tpu_compiler_params(**kwargs):
     """``pltpu.CompilerParams`` (current name) / ``TPUCompilerParams``
     (pre-rename) — resolved lazily so importing this module never pulls

@@ -1,0 +1,8 @@
+"""``python -m chainermn_tpu.analysis`` — see cli.py for the contract."""
+
+import sys
+
+from .cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

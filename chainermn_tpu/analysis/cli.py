@@ -1,0 +1,213 @@
+"""Runner: ``python -m chainermn_tpu.analysis`` / ``scripts/lint_spmd.py``.
+
+Exit-code contract (same as ``scripts/check_perf_regression.py``):
+
+* **0** — clean: no findings beyond the checked-in baseline;
+* **1** — findings: at least one non-baselined finding (any severity);
+* **2** — unusable: bad arguments, missing paths, broken baseline.
+
+Human output is one block per finding (``path:line: severity: rule
+[scope]: message``); ``--json`` emits a single machine document
+(``chainermn_tpu.spmd_lint.v1``) with the findings, the baseline-accepted
+count, and the per-entry-point collective sequences from the jaxpr engine.
+
+``--fix-baseline`` regenerates the baseline from the current findings —
+the INTENTIONAL way to accept a triaged finding; human-written comments
+on surviving entries are preserved.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+from .ast_engine import AST_RULES, analyze_paths
+from .findings import (BASELINE_FILENAME, Baseline, Finding, find_baseline,
+                       load_baseline)
+from .registry import default_registry
+
+SCHEMA = "chainermn_tpu.spmd_lint.v1"
+
+
+def _all_rules():
+    from .jaxpr_engine import JAXPR_RULES
+    out = dict(AST_RULES)
+    out.update(JAXPR_RULES)
+    return out
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m chainermn_tpu.analysis",
+        description="SPMD-aware static analyzer: collective-deadlock, "
+                    "PRNG, host-aliasing, and recompilation lint for "
+                    "JAX code (docs/ANALYSIS.md)")
+    p.add_argument("paths", nargs="*", default=None,
+                   help="files/directories to scan (default: the "
+                        "chainermn_tpu package directory)")
+    p.add_argument("--json", action="store_true",
+                   help="one machine-readable JSON document on stdout")
+    p.add_argument("--baseline", default=None,
+                   help=f"baseline file (default: nearest "
+                        f"{BASELINE_FILENAME} above the first path)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore any baseline: report everything")
+    p.add_argument("--fix-baseline", action="store_true",
+                   help="regenerate the baseline from current findings "
+                        "(intentional acceptance; keeps existing comments)")
+    p.add_argument("--rules", default=None,
+                   help="comma-separated rule subset to run")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule catalog and exit")
+    p.add_argument("--no-jaxpr", action="store_true",
+                   help="skip the jaxpr engine (no jax import: pure-AST "
+                        "mode, runs on any box)")
+    return p
+
+
+def _package_dir() -> str:
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+
+    if args.list_rules:
+        for rule, (sev, desc) in sorted(_all_rules().items()):
+            print(f"{rule:24s} {sev:8s} {desc}")
+        return 0
+
+    paths = args.paths or [_package_dir()]
+    missing = [p for p in paths if not os.path.exists(p)]
+    if missing:
+        print(f"error: no such path(s): {', '.join(missing)}",
+              file=sys.stderr)
+        return 2
+
+    rules = ([r.strip() for r in args.rules.split(",") if r.strip()]
+             if args.rules else None)
+    if rules:
+        unknown = set(rules) - set(_all_rules())
+        if unknown:
+            print(f"error: unknown rule(s): {', '.join(sorted(unknown))} "
+                  "(see --list-rules)", file=sys.stderr)
+            return 2
+
+    registry = default_registry()
+    findings = analyze_paths(paths, registry=registry, rules=rules)
+
+    reports = []
+    if not args.no_jaxpr:
+        try:
+            from .jaxpr_engine import check_entrypoints
+            jf, reports = check_entrypoints()
+            if rules is not None:
+                # entrypoint-error bypasses the filter: "this entry point
+                # could not be analyzed" must never read as "clean under
+                # rule X" (same carve-out as the AST engine's parse-error)
+                jf = [f for f in jf
+                      if f.rule in rules or f.rule == "entrypoint-error"]
+            findings.extend(jf)
+        except ImportError as e:
+            print(f"note: jaxpr engine skipped (jax unavailable: {e})",
+                  file=sys.stderr)
+
+    # ---- normalize paths for stable fingerprints regardless of cwd:
+    # anchor at the baseline's directory when it contains every scanned
+    # path (the checked-in layout), else at the scanned paths' common
+    # ancestor — NEVER at a root that forces "../" segments, which would
+    # bake the checkout's absolute location into fingerprints ----
+    baseline: Optional[Baseline] = None
+    bl_path = args.baseline or find_baseline(paths[0])
+    abs_paths = [os.path.abspath(p) for p in paths]
+    common = os.path.commonpath(abs_paths)
+    if os.path.isfile(common):
+        common = os.path.dirname(common)
+    root = common
+    if bl_path:
+        bl_dir = os.path.dirname(os.path.abspath(bl_path))
+        if os.path.commonpath([bl_dir, common]) == bl_dir:
+            root = bl_dir
+    for f in findings:
+        if f.path and not f.path.startswith("entrypoint:"):
+            f.path = os.path.relpath(os.path.abspath(f.path), root)
+
+    if not args.no_baseline and bl_path and os.path.exists(bl_path):
+        try:
+            baseline = load_baseline(bl_path)
+        except (OSError, ValueError, KeyError) as e:
+            print(f"error: unreadable baseline {bl_path}: {e}",
+                  file=sys.stderr)
+            return 2
+
+    if args.fix_baseline:
+        target = bl_path or os.path.join(root, BASELINE_FILENAME)
+        new_bl = Baseline.from_findings(findings, path=target)
+        carried = 0
+        if baseline is not None:
+            # regeneration is scoped to THIS invocation: entries for
+            # paths not scanned, rules filtered out, or entry points not
+            # run (--no-jaxpr) are carried over untouched — a partial
+            # `--fix-baseline chainermn_tpu/` must not wipe the
+            # examples/ keepers
+            def in_scope(entry) -> bool:
+                p = entry["path"]
+                if p.startswith("entrypoint:"):
+                    return not args.no_jaxpr and (
+                        rules is None or entry["rule"] in rules
+                        or entry["rule"] == "entrypoint-error")
+                if rules is not None and entry["rule"] not in rules \
+                        and entry["rule"] != "parse-error":
+                    return False
+                ap = os.path.normpath(os.path.join(root, p))
+                return any(ap == sp or ap.startswith(sp + os.sep)
+                           for sp in abs_paths)
+
+            for fp, e in baseline.entries.items():
+                if not in_scope(e) and fp not in new_bl.entries:
+                    new_bl.entries[fp] = dict(e)
+                    carried += 1
+            new_bl.merge_comments_from(baseline)
+        new_bl.save()
+        extra = f", {carried} out-of-scope carried over" if carried else ""
+        print(f"baseline written: {target} ({len(new_bl.entries)} "
+              f"accepted findings{extra})", file=sys.stderr)
+        return 0
+
+    accepted: List[Finding] = []
+    if baseline is not None:
+        findings, accepted = baseline.filter(findings)
+
+    if args.json:
+        doc = {
+            "schema": SCHEMA,
+            "paths": [os.path.relpath(os.path.abspath(p), root)
+                      for p in paths],
+            "baseline": (os.path.relpath(bl_path, root)
+                         if bl_path and baseline is not None else None),
+            "n_accepted_by_baseline": len(accepted),
+            "findings": [f.to_dict() for f in findings],
+            "entrypoints": [
+                {"name": r.name,
+                 "collectives": [list(c) for c in r.collectives],
+                 "n_compiles": r.n_compiles,
+                 "error": r.error} for r in reports],
+        }
+        print(json.dumps(doc, indent=2))
+    else:
+        for f in findings:
+            print(f.render())
+        sev = {}
+        for f in findings:
+            sev[f.severity] = sev.get(f.severity, 0) + 1
+        tally = ", ".join(f"{n} {s}" for s, n in sorted(sev.items())) or \
+            "no findings"
+        extra = (f" ({len(accepted)} accepted by baseline)"
+                 if accepted else "")
+        print(f"spmd-lint: {tally}{extra} over {len(paths)} path(s)",
+              file=sys.stderr)
+
+    return 1 if findings else 0

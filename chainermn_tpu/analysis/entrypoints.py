@@ -1,0 +1,172 @@
+"""Registered entry points for the jaxpr engine.
+
+Each entry point names one REAL program of this repo — the collective
+vocabulary of ``ops/collective.py``, the TP decode tick the serving
+engine drives, and the per-prompt-length prefill family — built at tiny
+shapes (d_model=8, one layer, axis size 1) so the whole sweep traces in
+seconds on one CPU device.  Axis size 1 is enough: collectives still
+appear as jaxpr equations with their axis names, which is all the
+unbound-axis check reads; the recompile probes execute for real but on
+KB-sized arrays.
+
+Entry points are the extension surface: a new subsystem that adds a
+compiled program registers it here and the analyzer owns it from then
+on (docs/ANALYSIS.md shows the recipe).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from .jaxpr_engine import EntryPoint
+
+_SEED = 0  # analysis must trace the same program every run
+
+
+def _tiny_lm(tp: int = 1):
+    """Shared tiny TP transformer-LM fixture: (params, specs, mesh)."""
+    import jax
+
+    from chainermn_tpu import topology
+    from chainermn_tpu.parallel.transformer import (
+        init_tp_transformer_lm, transformer_lm_specs)
+
+    params = init_tp_transformer_lm(
+        jax.random.PRNGKey(_SEED), 16, 8, 2, 1, max_len=8)
+    specs = transformer_lm_specs(params, "model")
+    mesh = topology.make_nd_mesh(("model",), (tp,), jax.devices()[:tp])
+    return params, specs, mesh
+
+
+def _build_collective_ring() -> Dict[str, Any]:
+    """The ops/collective.py vocabulary under one shard_map binding —
+    psum / reduce_scatter / all_gather / shift in the gradient-ring order
+    the train CLI demos."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from chainermn_tpu import topology
+    from chainermn_tpu._compat import shard_map
+    from chainermn_tpu.ops import collective as C
+    from jax.sharding import PartitionSpec as P
+
+    mesh = topology.make_nd_mesh(("mn",), (1,), jax.devices()[:1])
+
+    def body(x):
+        g = C.reduce_scatter(x, "mn")
+        g = C.all_gather(g, "mn")
+        g = C.shift(g, 1, "mn", size=1)
+        return C.psum(g, "mn")
+
+    fn = shard_map(body, mesh=mesh, in_specs=(P(),), out_specs=P())
+    x = np.ones((4,), np.float32)
+
+    def run(v):
+        return fn(jnp.asarray(v))
+
+    return {"trace": (run, (x,)), "bound_axes": {"mn"}}
+
+
+def _build_decode_tick() -> Dict[str, Any]:
+    """One serving decode tick (the pool-lifetime compiled program):
+    traced for its collective sequence AND probed for recompilation —
+    two calls with different token/pos VALUES must reuse ONE program."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from chainermn_tpu._compat import shard_map
+    from chainermn_tpu.parallel.decode import lm_decode_tick, lm_prefill
+    from jax.sharding import PartitionSpec as P
+
+    params, specs, mesh = _tiny_lm()
+    head_dim = 4
+    total = 8
+
+    prompt = np.zeros((1, 3), np.int32)
+
+    def tick(p, tokens, caches, pos):
+        return lm_decode_tick(p, tokens, caches, pos, head_dim=head_dim,
+                              axis_name="model")
+
+    def prefill(p, pr):
+        return lm_prefill(p, pr, total, head_dim=head_dim,
+                          axis_name="model")
+
+    sm_prefill = shard_map(prefill, mesh=mesh, in_specs=(specs, P()),
+                           out_specs=(P(), [(P(), P())]))
+    _, caches = sm_prefill(params, jnp.asarray(prompt))
+
+    cache_specs = [(P(), P()) for _ in caches]
+    sm_tick = jax.jit(shard_map(
+        tick, mesh=mesh, in_specs=(specs, P(), cache_specs, P()),
+        out_specs=(P(), cache_specs)))
+
+    tokens = np.zeros((1,), np.int32)
+    pos = np.asarray([3], np.int32)
+
+    def run(p, t, c, q):
+        return sm_tick(p, t, c, q)
+
+    variants = (sm_tick, [
+        (params, jnp.asarray(tokens), caches, jnp.asarray(pos)),
+        (params, jnp.asarray(tokens + 1), caches,
+         jnp.asarray(pos + 1)),
+    ])
+    return {"trace": (run, (params, jnp.asarray(tokens), caches,
+                            jnp.asarray(pos))),
+            "bound_axes": {"model"},
+            "variants": variants}
+
+
+def _build_prefill_family() -> Dict[str, Any]:
+    """The per-prompt-length prefill programs: one compile PER prompt
+    length is the serving engine's documented design (docs/SERVING.md) —
+    registered allow_recompile=True so the hazard is named, not flagged."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from chainermn_tpu._compat import shard_map
+    from chainermn_tpu.parallel.decode import lm_prefill
+    from jax.sharding import PartitionSpec as P
+
+    params, specs, mesh = _tiny_lm()
+    head_dim = 4
+    total = 8
+
+    def prefill(p, pr):
+        return lm_prefill(p, pr, total, head_dim=head_dim,
+                          axis_name="model")
+
+    jfn = jax.jit(shard_map(
+        prefill, mesh=mesh, in_specs=(specs, P()),
+        out_specs=(P(), [(P(), P())])))
+
+    p2 = np.zeros((1, 2), np.int32)
+    p3 = np.zeros((1, 3), np.int32)
+    return {"trace": (lambda p, pr: jfn(p, pr), (params, jnp.asarray(p2))),
+            "bound_axes": {"model"},
+            "variants": (jfn, [(params, jnp.asarray(p2)),
+                               (params, jnp.asarray(p3))])}
+
+
+ENTRYPOINTS = [
+    EntryPoint(
+        name="ops.collective.ring",
+        build=_build_collective_ring,
+        description="reduce_scatter+all_gather+shift+psum gradient ring "
+                    "over axis 'mn' (the train CLI's demo reduction)"),
+    EntryPoint(
+        name="parallel.decode.lm_decode_tick",
+        build=_build_decode_tick,
+        description="serving decode tick under shard_map('model') — one "
+                    "program for the pool's lifetime"),
+    EntryPoint(
+        name="serving.prefill_family",
+        build=_build_prefill_family,
+        allow_recompile=True,
+        description="per-prompt-length prefill programs (intentional "
+                    "program family, see docs/SERVING.md)"),
+]

@@ -136,17 +136,22 @@ class Seq2seq(nn.Module):
         carry = self.encode(src)
         bos = jnp.full((batch,), BOS, jnp.int32)
 
-        def step(state, _):
+        # LIFTED scan (nn.scan), not raw lax.scan: the step closes over
+        # bound submodules (embed_y/decoder/proj), and flax forbids raw
+        # jax transforms over bound state (JaxTransformError on 0.10.x);
+        # nn.scan broadcasts the params collection through the loop.
+        def step(mdl, state, _):
             carry, tok, done = state
-            emb = self.embed_y(tok[:, None])
-            carry, h = self.decoder(carry, emb)
-            nxt = self.proj(h[:, 0]).astype(jnp.float32).argmax(-1).astype(jnp.int32)
+            emb = mdl.embed_y(tok[:, None])
+            carry, h = mdl.decoder(carry, emb)
+            nxt = mdl.proj(h[:, 0]).astype(jnp.float32).argmax(-1).astype(jnp.int32)
             nxt = jnp.where(done, PAD, nxt)
             done = done | (nxt == EOS)
             return (carry, nxt, done), nxt
 
-        _, toks = jax.lax.scan(
-            step, (carry, bos, jnp.zeros((batch,), bool)), None, length=max_len)
+        scan = nn.scan(step, variable_broadcast="params",
+                       split_rngs={"params": False}, length=max_len)
+        _, toks = scan(self, (carry, bos, jnp.zeros((batch,), bool)), None)
         return jnp.swapaxes(toks, 0, 1)  # (B, max_len)
 
 

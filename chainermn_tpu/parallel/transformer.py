@@ -31,7 +31,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from .._compat import pcast_varying
+from .._compat import pcast_varying, typeof as _typeof
 from .tensor_parallel import column_parallel_dense, row_parallel_dense, tp_mlp
 
 
@@ -315,8 +315,8 @@ def vocab_parallel_logits_loss(h, table, targets, *, axis_name: str,
         # bypassed machinery would have.  When vma tracking is off
         # (check_vma=False contexts) there is nothing to promote; the
         # backward hand-psums dh over the model axis instead.
-        hv = set(getattr(jax.typeof(h2), "vma", frozenset()))
-        tv = set(getattr(jax.typeof(table), "vma", frozenset()))
+        hv = set(getattr(_typeof(h2), "vma", frozenset()))
+        tv = set(getattr(_typeof(table), "vma", frozenset()))
         vma_active = bool(hv or tv)
         if vma_active:
             union = hv | tv | {axis_name}

@@ -58,6 +58,9 @@ def main(argv=None):
                         choices=["learned", "rope"])
     parser.add_argument("--train-steps", type=int, default=60,
                         help="toy-LM training steps before serving")
+    parser.add_argument("--seed", type=int, default=0,
+                        help="PRNG seed for model init (spmd-lint: literal "
+                             "PRNGKey seeds belong on the CLI, not in code)")
     parser.add_argument("--lr", type=float, default=1e-2)
     parser.add_argument("--n-slots", type=int, default=4)
     parser.add_argument("--max-total", type=int, default=None,
@@ -116,7 +119,7 @@ def main(argv=None):
 
     # ---- train the toy LM (same recipe as examples/generate) ----
     params = init_tp_transformer_lm(
-        jax.random.PRNGKey(0), args.vocab, args.d_model, args.n_heads,
+        jax.random.PRNGKey(args.seed), args.vocab, args.d_model, args.n_heads,
         args.n_layers, max_len=max_len, pos_impl=args.pos_impl,
         n_kv_heads=args.kv_heads)
     train_mesh = mn.make_nd_mesh(("data", "model"), (dp, args.tp))

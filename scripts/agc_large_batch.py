@@ -1,4 +1,6 @@
 #!/usr/bin/env python
+# spmd-lint: disable-file=prng-constant-key — fixed seeds are the point:
+# profile/probe runs must be bit-reproducible across commits to be comparable
 """Large-batch NF-ResNet convergence A/B: AGC on vs off at batch 4096.
 
 Round-5 directive #8.  NF-ResNets (models/resnet.py, Brock et al.'s

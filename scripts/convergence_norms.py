@@ -1,4 +1,6 @@
 #!/usr/bin/env python
+# spmd-lint: disable-file=prng-constant-key — fixed seeds are the point:
+# profile/probe runs must be bit-reproducible across commits to be comparable
 """Optimization-dynamics parity: BN ResNet-50 vs its traffic-saving variants.
 
 Same data (fixed synthetic labeled set, the no-network stand-in), same

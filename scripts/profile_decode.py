@@ -1,4 +1,6 @@
 #!/usr/bin/env python
+# spmd-lint: disable-file=prng-constant-key — fixed seeds are the point:
+# profile/probe runs must be bit-reproducible across commits to be comparable
 """Component breakdown of the greedy decode tick (bench config).
 
 Where does the per-token time go at d1024/L8/h16/V32k/b8?  Replicates

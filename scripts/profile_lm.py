@@ -1,4 +1,6 @@
 #!/usr/bin/env python
+# spmd-lint: disable-file=prng-constant-key — fixed seeds are the point:
+# profile/probe runs must be bit-reproducible across commits to be comparable
 """Component-level timing breakdown of the transformer-LM train step.
 
 Answers "where does the non-MXU time go" for the bench config

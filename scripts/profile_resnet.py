@@ -1,4 +1,6 @@
 #!/usr/bin/env python
+# spmd-lint: disable-file=prng-constant-key — fixed seeds are the point:
+# profile/probe runs must be bit-reproducible across commits to be comparable
 """Where does ResNet-50's step time go on the real chip?
 
 Scan-chained single-dispatch timings (see axon timing recipe in

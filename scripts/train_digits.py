@@ -1,4 +1,6 @@
 #!/usr/bin/env python
+# spmd-lint: disable-file=prng-constant-key — fixed seeds are the point:
+# profile/probe runs must be bit-reproducible across commits to be comparable
 """Real-data convergence proof: FileDataset → prefetch ring → chip → metric.
 
 VERDICT r3 #6 asked for one committed convergence artifact where the

@@ -36,3 +36,5 @@ def devices():
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: subprocess-spawning tests (larger virtual meshes)")
+    config.addinivalue_line(
+        "markers", "lint: SPMD static-analysis gate (pytest -m lint)")

@@ -1,0 +1,38 @@
+"""BAD: collectives under rank-dependent control flow — every variant
+here strands part of the gang inside a collective the rest never enters."""
+import jax
+
+from chainermn_tpu.ops.collective import all_gather, psum
+
+
+def guarded_branch(x, comm):
+    if comm.rank == 0:
+        return psum(x)          # only rank 0 reduces: gang deadlock
+    return x
+
+
+def early_exit(x):
+    if jax.lax.axis_index("mn") == 0:
+        return x                # rank 0 leaves...
+    return all_gather(x)        # ...the rest gather forever
+
+
+def rank_trip_count(x, comm):
+    total = x
+    for _ in range(comm.rank):  # different iteration counts per rank
+        total = psum(total)
+    return total
+
+
+def eager_guarded(x, comm):
+    if comm.rank == 0:
+        comm.bcast_obj({"step": 1})  # root broadcasts, nobody listens
+    return x
+
+
+def nested_under_guard(x, comm):
+    total = x
+    if comm.rank == 0:
+        for _ in range(3):
+            total = psum(total)     # one block deeper, still rank-guarded
+    return total

@@ -1,0 +1,17 @@
+"""CLEAN: collectives run symmetrically; only host-side IO is guarded."""
+import jax
+
+from chainermn_tpu.ops.collective import all_gather, psum
+
+
+def symmetric(x, comm):
+    g = psum(x)                 # every rank reduces
+    if comm.rank == 0:
+        print(float(g))         # only the PRINT is rank-guarded
+    return g
+
+
+def gather_then_report(x, comm):
+    y = all_gather(x)
+    idx = jax.lax.axis_index("mn")
+    return y, idx               # rank value used as data, not control flow

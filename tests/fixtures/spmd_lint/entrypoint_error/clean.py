@@ -1,0 +1,15 @@
+"""CLEAN entry point: builds, traces, and runs without incident."""
+from chainermn_tpu.analysis.jaxpr_engine import EntryPoint
+
+
+def _build():
+    import jax
+    import numpy as np
+
+    fn = jax.jit(lambda x: x * 2)
+    x = np.ones((2,), np.float32)
+    return {"trace": (fn, (x,)), "bound_axes": set(),
+            "variants": (fn, [(x,), (x + 1,)])}
+
+
+ENTRYPOINT = EntryPoint(name="fixture.entrypoint_error.clean", build=_build)

@@ -1,0 +1,19 @@
+"""BAD: the PR 3 serving race, generalized — `jnp.asarray` of a numpy
+buffer may be ZERO-COPY on CPU, and jax dispatch is async: mutating the
+buffer in place can change the bytes a still-running compiled program
+reads (seen as repeated first tokens under cold-compile latency)."""
+import jax.numpy as jnp
+import numpy as np
+
+
+def tick(pos_host, step_fn):
+    pos_dev = jnp.asarray(pos_host)    # may alias pos_host's memory
+    out = step_fn(pos_dev)
+    pos_host += 1                      # races the async read above
+    return out
+
+
+def view_mutation(tokens):
+    stacked = np.asarray(tokens)       # np.asarray of ndarray is a VIEW
+    stacked[0] = -1                    # writes through to `tokens`
+    return stacked

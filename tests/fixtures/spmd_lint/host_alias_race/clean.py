@@ -1,0 +1,16 @@
+"""CLEAN: copy at the host/device boundary — the PR 3 fix."""
+import jax.numpy as jnp
+import numpy as np
+
+
+def tick(pos_host, step_fn):
+    pos_dev = jnp.asarray(pos_host.copy())  # boundary COPIES
+    out = step_fn(pos_dev)
+    pos_host += 1                           # mutates only the host copy
+    return out
+
+
+def fresh_array(tokens):
+    stacked = np.array(tokens)              # np.array copies by default
+    stacked[0] = -1
+    return stacked

@@ -1,0 +1,21 @@
+"""BAD: in-place numpy mutation of a buffer also handed to a jitted
+call — with donation or zero-copy the compiled program may still alias
+the buffer when the mutation lands."""
+import jax
+import numpy as np
+
+
+def _step(tokens, state):
+    return state
+
+
+step = jax.jit(_step)
+
+
+def drive(n):
+    tokens = np.zeros((4,), np.int32)
+    state = np.zeros((4,), np.float32)
+    for _ in range(n):
+        state = step(tokens, state)
+        tokens[0] = 1           # mutates a live jit argument
+    return state

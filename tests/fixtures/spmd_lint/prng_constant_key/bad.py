@@ -1,0 +1,12 @@
+"""BAD: literal-seed keys — every run (and rank) draws the same bits."""
+import jax
+
+
+def sample(shape):
+    key = jax.random.PRNGKey(0)        # the PR 3 sampling trap
+    return jax.random.normal(key, shape)
+
+
+def newstyle(shape):
+    k = jax.random.key(42)             # new typed-key API, same trap
+    return jax.random.uniform(k, shape)

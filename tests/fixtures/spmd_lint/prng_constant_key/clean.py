@@ -1,0 +1,12 @@
+"""CLEAN: seeds arrive from config/CLI; literals never touch PRNGKey."""
+import jax
+
+
+def sample(shape, seed):
+    key = jax.random.PRNGKey(seed)     # caller owns the seed
+    return jax.random.normal(key, shape)
+
+
+def per_step(key, step, shape):
+    k = jax.random.fold_in(key, step)  # fresh stream per step
+    return jax.random.uniform(k, shape)

@@ -1,0 +1,15 @@
+"""BAD: one key, many draws — the draws are IDENTICAL bits per shape."""
+import jax
+
+
+def correlated_init(key, n):
+    w = jax.random.normal(key, (n, n))
+    b = jax.random.normal(key, (n,))       # same key: correlated with w
+    return w, b
+
+
+def loop_reuse(key, xs):
+    out = []
+    for x in xs:
+        out.append(x + jax.random.uniform(key, x.shape))  # every iter equal
+    return out

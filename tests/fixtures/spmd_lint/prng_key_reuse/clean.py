@@ -1,0 +1,17 @@
+"""CLEAN: split/fold_in between consumptions — independent streams."""
+import jax
+
+
+def independent_init(key, n):
+    kw, kb = jax.random.split(key)
+    w = jax.random.normal(kw, (n, n))
+    b = jax.random.normal(kb, (n,))
+    return w, b
+
+
+def loop_fresh(key, xs):
+    out = []
+    for x in xs:
+        key, sub = jax.random.split(key)   # re-split every iteration
+        out.append(x + jax.random.uniform(sub, x.shape))
+    return out

@@ -1,0 +1,25 @@
+"""BAD entry point: a per-call-varying static arg — every call compiles
+a fresh program (the hazard the serving engine's tick avoids by keeping
+one pool-lifetime program)."""
+from functools import partial
+
+from chainermn_tpu.analysis.jaxpr_engine import EntryPoint
+
+
+def _build():
+    import jax
+    import numpy as np
+
+    @partial(jax.jit, static_argnames=("scale",))
+    def scaled(x, scale):
+        return x * scale
+
+    x = np.ones((2,), np.float32)
+    return {"trace": (lambda v: scaled(v, 1.0), (x,)),
+            "bound_axes": set(),
+            # scale varies per call -> one compile per distinct value
+            "variants": (scaled, [(x, 1.0), (x, 2.0), (x, 3.0)]),
+            "static_values": [{"lr": 0.1}]}   # dict: unhashable static
+
+
+ENTRYPOINT = EntryPoint(name="fixture.recompile.bad", build=_build)

@@ -1,0 +1,23 @@
+"""CLEAN entry point: the varying quantity is a TRACED input — one
+program serves every call (and the static config is hashable)."""
+from chainermn_tpu.analysis.jaxpr_engine import EntryPoint
+
+
+def _build():
+    import jax
+    import numpy as np
+
+    @jax.jit
+    def scaled(x, scale):
+        return x * scale
+
+    x = np.ones((2,), np.float32)
+    one = np.float32(1.0)
+    two = np.float32(2.0)
+    return {"trace": (scaled, (x, one)),
+            "bound_axes": set(),
+            "variants": (scaled, [(x, one), (x, two)]),
+            "static_values": [("adam", 0.1)]}   # tuple: hashable
+
+
+ENTRYPOINT = EntryPoint(name="fixture.recompile.clean", build=_build)

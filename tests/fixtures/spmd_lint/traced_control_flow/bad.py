@@ -1,0 +1,20 @@
+"""BAD: Python branches on traced values inside jit — the branch resolves
+at TRACE time (TracerBoolConversionError, or a silently specialized
+program that ignores the runtime value)."""
+import jax
+from functools import partial
+
+
+@jax.jit
+def relu_or_zero(x):
+    if x > 0:                   # traced: cannot branch in Python
+        return x
+    return x * 0
+
+
+@partial(jax.jit, static_argnames=("n",))
+def countdown(x, n, m):
+    while m > 0:                # m is traced (n would be fine: static)
+        x = x + 1
+        m = m - 1
+    return x

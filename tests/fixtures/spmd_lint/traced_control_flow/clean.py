@@ -1,0 +1,23 @@
+"""CLEAN: shape/static branches and lax control flow inside jit."""
+import jax
+import jax.numpy as jnp
+from functools import partial
+
+
+@jax.jit
+def relu(x):
+    if x.ndim > 1:              # shape attrs are static: fine
+        x = x.reshape(-1)
+    return jnp.maximum(x, 0)    # data-dependence via lax ops, not Python
+
+
+@partial(jax.jit, static_argnames=("n",))
+def repeat(x, n):
+    for _ in range(n):          # n is static: Python loop unrolls at trace
+        x = x + 1
+    return x
+
+
+@jax.jit
+def clamp(x, lo):
+    return jax.lax.select(x > lo, x, lo)   # data branch via lax.select

@@ -1,0 +1,24 @@
+"""BAD entry point: the body psums over axis 'mn' but the shard_map
+binding only provides 'model' — the compiled gang would never agree."""
+from chainermn_tpu.analysis.jaxpr_engine import EntryPoint
+
+
+def _build():
+    import jax
+    import numpy as np
+
+    from chainermn_tpu import topology
+    from chainermn_tpu._compat import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    mesh = topology.make_nd_mesh(("model",), (1,), jax.devices()[:1])
+
+    def body(x):
+        return jax.lax.psum(x, "mn")   # axis absent from the mesh
+
+    fn = shard_map(body, mesh=mesh, in_specs=(P(),), out_specs=P())
+    return {"trace": (fn, (np.ones((2,), np.float32),)),
+            "bound_axes": {"model"}}
+
+
+ENTRYPOINT = EntryPoint(name="fixture.unbound_axis.bad", build=_build)

@@ -1,0 +1,23 @@
+"""CLEAN entry point: every collective names the bound axis."""
+from chainermn_tpu.analysis.jaxpr_engine import EntryPoint
+
+
+def _build():
+    import jax
+    import numpy as np
+
+    from chainermn_tpu import topology
+    from chainermn_tpu._compat import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    mesh = topology.make_nd_mesh(("model",), (1,), jax.devices()[:1])
+
+    def body(x):
+        return jax.lax.psum(x, "model")
+
+    fn = shard_map(body, mesh=mesh, in_specs=(P(),), out_specs=P())
+    return {"trace": (fn, (np.ones((2,), np.float32),)),
+            "bound_axes": {"model"}}
+
+
+ENTRYPOINT = EntryPoint(name="fixture.unbound_axis.clean", build=_build)

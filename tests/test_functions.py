@@ -94,16 +94,23 @@ def test_gather_forward():
 # ---- gradients: backward is the transpose collective ----
 
 def grad_through(fn, x):
-    """d/dx of sum(fn(x)) via the SPMD program."""
+    """d/dx of the GLOBAL sum of fn(x) via the SPMD program.
+
+    Each rank differentiates its LOCAL partial sum; cross-rank coupling
+    flows through the transpose collectives inside ``fn``, so the result
+    is exactly d(Σ_r loss_r)/dx.  Deliberately NO outer ``psum`` on the
+    scalar: under legacy shard_map with the replication checker off
+    (``_compat.shard_map`` on this container's jax), ``psum`` transposes
+    to ``psum`` rather than identity, inflating every gradient by the
+    axis size — the local-loss form is correct under both regimes.
+    """
     mesh = mn.make_mesh()
 
-    def spmd_loss(b):
-        # psum so the scalar is the replicated GLOBAL sum: cotangents are 1
-        # and gradients read directly as transpose-collective routing
-        return jax.lax.psum(jnp.sum(fn(b)), "mn")
+    def local_loss(b):
+        return jnp.sum(fn(b))
 
     g = jax.jit(jax.shard_map(
-        jax.grad(lambda b: spmd_loss(b)), mesh=mesh,
+        jax.grad(local_loss), mesh=mesh,
         in_specs=P("mn"), out_specs=P("mn")))
     return np.asarray(g(x))
 

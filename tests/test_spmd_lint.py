@@ -1,0 +1,431 @@
+"""Tier-1 lint gate + analyzer unit tests (``pytest -m lint``).
+
+Three layers:
+
+* fixture corpus (tests/fixtures/spmd_lint/): every rule FIRES on its
+  bad snippet and stays QUIET on its clean twin — 0 false negatives on
+  bad, 0 findings of any kind on clean;
+* the registry derives the collective surface from source (closure
+  guard: a collective added to ops/collective.py is linted the day it
+  lands, same spirit as the observability accounting-completeness test);
+* the SELF-RUN: the shipped tree must be clean modulo the checked-in
+  baseline — deleting a baseline entry for a seeded violation makes
+  THIS test fail, which is the whole point of the gate.
+"""
+
+import ast
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from chainermn_tpu.analysis import (AST_RULES, analyze_file, analyze_paths,
+                                    analyze_source, default_registry,
+                                    load_baseline)
+from chainermn_tpu.analysis.findings import Baseline, Finding, Suppressions
+from chainermn_tpu.analysis.jaxpr_engine import (JAXPR_RULES,
+                                                 check_entrypoint,
+                                                 check_entrypoints)
+
+pytestmark = pytest.mark.lint
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "fixtures", "spmd_lint")
+BASELINE = os.path.join(REPO, ".spmd-lint-baseline.json")
+
+#: rule id -> fixture directory (AST rules)
+AST_FIXTURE_DIRS = {
+    "collective-deadlock": "collective_deadlock",
+    "prng-constant-key": "prng_constant_key",
+    "prng-key-reuse": "prng_key_reuse",
+    "host-alias-race": "host_alias_race",
+    "traced-control-flow": "traced_control_flow",
+    "inplace-jit-mutation": "inplace_jit_mutation",
+}
+JAXPR_FIXTURE_DIRS = {
+    "unbound-axis": "unbound_axis",
+    "recompile-hazard": "recompile_hazard",
+    "entrypoint-error": "entrypoint_error",
+}
+
+
+def _load_fixture_entrypoint(dirname, which):
+    path = os.path.join(FIXTURES, dirname, which + ".py")
+    spec = importlib.util.spec_from_file_location(
+        f"spmd_lint_fixture_{dirname}_{which}", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.ENTRYPOINT
+
+
+class TestFixtureCorpus:
+    @pytest.mark.parametrize("rule", sorted(AST_FIXTURE_DIRS))
+    def test_bad_fires(self, rule):
+        path = os.path.join(FIXTURES, AST_FIXTURE_DIRS[rule], "bad.py")
+        found = {f.rule for f in analyze_file(path)}
+        assert rule in found, f"{rule} missed its bad fixture (found {found})"
+
+    @pytest.mark.parametrize("rule", sorted(AST_FIXTURE_DIRS))
+    def test_clean_is_silent(self, rule):
+        path = os.path.join(FIXTURES, AST_FIXTURE_DIRS[rule], "clean.py")
+        findings = analyze_file(path)
+        assert findings == [], (
+            f"false positives on clean fixture: "
+            f"{[(f.rule, f.line) for f in findings]}")
+
+    def test_bad_fixture_finding_counts(self):
+        # the deadlock fixture seeds FIVE distinct deadlock shapes —
+        # including a collective one plain-loop level BELOW the guard
+        path = os.path.join(FIXTURES, "collective_deadlock", "bad.py")
+        hits = [f for f in analyze_file(path)
+                if f.rule == "collective-deadlock"]
+        assert len(hits) >= 5
+        contexts = {f.context for f in hits}
+        assert {"guarded_branch", "early_exit", "rank_trip_count",
+                "eager_guarded", "nested_under_guard"} <= contexts
+
+    def test_guard_survives_nested_blocks(self):
+        # regression: the guard must propagate through non-rank if/with/
+        # try/loop nesting, not just direct statements of the branch
+        code = (
+            "from chainermn_tpu.ops.collective import psum\n"
+            "def f(x, comm, tracer, retries):\n"
+            "    if comm.rank == 0:\n"
+            "        with tracer.span('sync'):\n"
+            "            try:\n"
+            "                for _ in range(retries):\n"
+            "                    x = psum(x)\n"
+            "            except ValueError:\n"
+            "                x = psum(x)\n"
+            "    return x\n")
+        hits = [f for f in analyze_source(code, "t.py")
+                if f.rule == "collective-deadlock"]
+        assert len(hits) == 2, [(f.line, f.message) for f in hits]
+
+    @pytest.mark.parametrize("rule", sorted(JAXPR_FIXTURE_DIRS))
+    def test_jaxpr_bad_fires(self, rule):
+        ep = _load_fixture_entrypoint(JAXPR_FIXTURE_DIRS[rule], "bad")
+        findings, _ = check_entrypoint(ep)  # must REPORT, never raise
+        assert rule in {f.rule for f in findings}
+
+    @pytest.mark.parametrize("rule", sorted(JAXPR_FIXTURE_DIRS))
+    def test_jaxpr_clean_is_silent(self, rule):
+        ep = _load_fixture_entrypoint(JAXPR_FIXTURE_DIRS[rule], "clean")
+        findings, report = check_entrypoint(ep)
+        assert findings == [], [f.message for f in findings]
+        assert report.error is None
+
+    def test_recompile_bad_counts_compiles_and_unhashable(self):
+        ep = _load_fixture_entrypoint("recompile_hazard", "bad")
+        findings, report = check_entrypoint(ep)
+        msgs = [f.message for f in findings]
+        assert report.n_compiles == 3
+        assert any("3 compiled programs" in m for m in msgs)
+        assert any("unhashable" in m for m in msgs)
+
+
+class TestRegistry:
+    def test_surface_is_derived_not_hardcoded(self):
+        reg = default_registry()
+        # in-jit face: every public def of ops/collective.py minus the
+        # non-communicating helpers
+        src = os.path.join(REPO, "chainermn_tpu", "ops", "collective.py")
+        tree = ast.parse(open(src).read())
+        public = {n.name for n in tree.body
+                  if isinstance(n, ast.FunctionDef)
+                  and not n.name.startswith("_")}
+        expected = public - {"zeros_like_vma", "axis_index", "axis_size"}
+        assert expected == reg.ops_collectives
+        assert "quantized_ring_pmean" in reg.ops_collectives
+        assert "hierarchical_pmean" in reg.ops_collectives
+        # eager face: the _ACCOUNTED_OPS literal + the object lane
+        assert {"allreduce", "bcast", "multi_node_mean_grad",
+                "bcast_obj", "allgather_obj"} <= reg.comm_methods
+
+    def test_new_collective_is_picked_up(self, tmp_path):
+        # simulate a new collective landing in ops/collective.py
+        pkg = tmp_path / "pkg"
+        (pkg / "ops").mkdir(parents=True)
+        (pkg / "communicators").mkdir()
+        (pkg / "ops" / "collective.py").write_text(
+            "def pfancy(x, axis_name='mn'):\n    return x\n")
+        (pkg / "communicators" / "base.py").write_text(
+            "_ACCOUNTED_OPS = ('allreduce',)\n"
+            "class CommunicatorBase:\n    pass\n")
+        reg = default_registry(str(pkg))
+        assert "pfancy" in reg.ops_collectives
+        code = ("def f(x, comm):\n"
+                "    if comm.rank == 0:\n"
+                "        return pfancy(x)\n"
+                "    return x\n")
+        findings = analyze_source(code, "t.py", registry=reg)
+        assert [f.rule for f in findings] == ["collective-deadlock"]
+
+
+class TestSuppressions:
+    BAD = ("import jax\n"
+           "def f():\n"
+           "    return jax.random.PRNGKey(0)\n")
+
+    def test_finding_without_suppression(self):
+        assert len(analyze_source(self.BAD, "t.py")) == 1
+
+    def test_inline_disable(self):
+        code = self.BAD.replace(
+            "PRNGKey(0)",
+            "PRNGKey(0)  # spmd-lint: disable=prng-constant-key")
+        assert analyze_source(code, "t.py") == []
+
+    def test_disable_next_line(self):
+        code = ("import jax\n"
+                "def f():\n"
+                "    # spmd-lint: disable-next-line=prng-constant-key\n"
+                "    return jax.random.PRNGKey(0)\n")
+        assert analyze_source(code, "t.py") == []
+
+    def test_disable_file(self):
+        code = "# spmd-lint: disable-file=prng-constant-key\n" + self.BAD
+        assert analyze_source(code, "t.py") == []
+
+    def test_wrong_rule_does_not_suppress(self):
+        code = self.BAD.replace(
+            "PRNGKey(0)",
+            "PRNGKey(0)  # spmd-lint: disable=collective-deadlock")
+        assert len(analyze_source(code, "t.py")) == 1
+
+
+class TestBaseline:
+    def test_fingerprint_survives_line_shift(self):
+        a = Finding(rule="r", severity="warning", path="p.py", line=10,
+                    message="m", context="f", snippet="x = PRNGKey(0)")
+        b = Finding(rule="r", severity="warning", path="p.py", line=99,
+                    message="m", context="f", snippet="x =  PRNGKey(0)")
+        assert a.fingerprint() == b.fingerprint()  # whitespace-normalized
+
+    def test_roundtrip_and_comment_preservation(self, tmp_path):
+        f = Finding(rule="r", severity="warning", path="p.py", line=1,
+                    message="m", context="f", snippet="s")
+        bl = Baseline.from_findings([f], comments={f.fingerprint(): "why"},
+                                    path=str(tmp_path / "b.json"))
+        bl.save()
+        loaded = load_baseline(str(tmp_path / "b.json"))
+        assert loaded.accepts(f)
+        assert loaded.entries[f.fingerprint()]["comment"] == "why"
+        # regen without comments keeps the human-written one
+        regen = Baseline.from_findings([f], path=loaded.path)
+        regen.merge_comments_from(loaded)
+        assert regen.entries[f.fingerprint()]["comment"] == "why"
+
+    def test_duplicate_findings_are_count_limited(self):
+        # two textually identical violations share a fingerprint; one
+        # baseline entry must NOT silently accept a new duplicate
+        def mk():
+            return Finding(rule="r", severity="warning", path="p.py",
+                           line=1, message="m", context="f",
+                           snippet="k = PRNGKey(0)")
+
+        one = Baseline.from_findings([mk()])
+        assert one.entries[mk().fingerprint()]["count"] == 1
+        new, accepted = one.filter([mk(), mk()])
+        assert len(accepted) == 1 and len(new) == 1
+
+        two = Baseline.from_findings([mk(), mk()])
+        assert two.entries[mk().fingerprint()]["count"] == 2
+        new, accepted = two.filter([mk(), mk()])
+        assert new == [] and len(accepted) == 2
+
+    def test_parse_error_bypasses_rule_filter(self):
+        broken = "def f(:\n"
+        fs = analyze_source(broken, "broken.py",
+                            rules=["prng-constant-key"])
+        assert [f.rule for f in fs] == ["parse-error"]
+
+
+class TestSelfRun:
+    """The shipped tree is clean modulo the shipped baseline.
+
+    Deleting a baseline entry (e.g. the seeded PRNGKey keepers in
+    examples/, or the paired-p2p keepers in communicators/xla.py)
+    makes these assertions fail — the tier-1 guarantee the ISSUE asks
+    for.
+    """
+
+    def _new_findings(self, baseline):
+        findings = analyze_paths([
+            os.path.join(REPO, "chainermn_tpu"),
+            os.path.join(REPO, "examples"),
+            os.path.join(REPO, "scripts"),
+        ])
+        root = os.path.dirname(BASELINE)
+        for f in findings:
+            f.path = os.path.relpath(os.path.abspath(f.path), root)
+        new, accepted = baseline.filter(findings)
+        return new, accepted
+
+    def test_tree_clean_modulo_baseline(self):
+        baseline = load_baseline(BASELINE)
+        new, accepted = self._new_findings(baseline)
+        assert new == [], "new spmd-lint findings:\n" + "\n".join(
+            f.render() for f in new)
+        # the baseline is not vacuous: the seeded keepers are really there
+        assert len(accepted) >= 10
+
+    def test_every_baseline_entry_still_matches(self):
+        # stale entries (finding fixed but baseline not regenerated) rot
+        # the gate; --fix-baseline exists for exactly this
+        baseline = load_baseline(BASELINE)
+        _, accepted = self._new_findings(baseline)
+        hit = {f.fingerprint() for f in accepted}
+        stale = set(baseline.entries) - hit
+        assert not stale, (
+            f"baseline entries no longer observed (run --fix-baseline): "
+            f"{[baseline.entries[s]['path'] for s in stale]}")
+
+    def test_every_baseline_entry_has_comment(self):
+        baseline = load_baseline(BASELINE)
+        missing = [e["path"] for e in baseline.entries.values()
+                   if not e.get("comment")]
+        assert not missing
+
+    def test_deleting_baseline_entry_fails_the_gate(self, tmp_path):
+        baseline = load_baseline(BASELINE)
+        doomed = next(fp for fp, e in baseline.entries.items()
+                      if e["rule"] == "prng-constant-key")
+        del baseline.entries[doomed]
+        new, _ = self._new_findings(baseline)
+        assert len(new) == 1 and new[0].fingerprint() == doomed
+
+    def test_registered_entrypoints_clean(self):
+        findings, reports = check_entrypoints()
+        assert findings == [], [f.message for f in findings]
+        by_name = {r.name: r for r in reports}
+        # the decode tick really is ONE program across value variants
+        assert by_name["parallel.decode.lm_decode_tick"].n_compiles == 1
+        # the prefill family really is per-length (and allowlisted)
+        assert by_name["serving.prefill_family"].n_compiles == 2
+        # collective sequences were extracted, not vacuously empty
+        assert by_name["ops.collective.ring"].collectives
+
+
+class TestCLI:
+    def test_module_form_exits_zero_against_baseline(self):
+        # the ISSUE's acceptance command, verbatim
+        r = subprocess.run(
+            [sys.executable, "-m", "chainermn_tpu.analysis",
+             "chainermn_tpu/"],
+            cwd=REPO, capture_output=True, text=True, timeout=600,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        assert r.returncode == 0, r.stdout + r.stderr
+
+    def test_script_exit_contract(self, tmp_path):
+        env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+        script = os.path.join(REPO, "scripts", "lint_spmd.py")
+
+        # 2 = unusable input
+        r = subprocess.run([sys.executable, script, "--no-jaxpr",
+                            "/no/such/path"], cwd=REPO,
+                           capture_output=True, text=True, env=env)
+        assert r.returncode == 2
+
+        # 1 = findings (bad fixture, no baseline)
+        r = subprocess.run(
+            [sys.executable, script, "--no-jaxpr", "--no-baseline",
+             "--json", os.path.join(FIXTURES, "prng_constant_key",
+                                    "bad.py")],
+            cwd=REPO, capture_output=True, text=True, env=env)
+        assert r.returncode == 1
+        doc = json.loads(r.stdout)
+        assert doc["schema"] == "chainermn_tpu.spmd_lint.v1"
+        assert {f["rule"] for f in doc["findings"]} == {"prng-constant-key"}
+
+        # 0 = clean
+        r = subprocess.run(
+            [sys.executable, script, "--no-jaxpr", "--no-baseline",
+             os.path.join(FIXTURES, "prng_constant_key", "clean.py")],
+            cwd=REPO, capture_output=True, text=True, env=env)
+        assert r.returncode == 0, r.stdout + r.stderr
+
+    def test_fix_baseline_roundtrip(self, tmp_path):
+        env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+        script = os.path.join(REPO, "scripts", "lint_spmd.py")
+        bad = os.path.join(FIXTURES, "prng_constant_key", "bad.py")
+        bl = tmp_path / "bl.json"
+
+        r = subprocess.run(
+            [sys.executable, script, "--no-jaxpr", "--fix-baseline",
+             "--baseline", str(bl), bad],
+            cwd=REPO, capture_output=True, text=True, env=env)
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert bl.exists()
+
+        r = subprocess.run(
+            [sys.executable, script, "--no-jaxpr", "--baseline", str(bl),
+             bad],
+            cwd=REPO, capture_output=True, text=True, env=env)
+        assert r.returncode == 0, r.stdout + r.stderr
+
+    def test_partial_fix_baseline_carries_out_of_scope_entries(self):
+        # regression: `--fix-baseline chainermn_tpu/` must not wipe the
+        # examples/ keepers (nor any entry outside the scanned scope)
+        env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+        script = os.path.join(REPO, "scripts", "lint_spmd.py")
+        before = load_baseline(BASELINE)
+        r = subprocess.run(
+            [sys.executable, script, "--no-jaxpr", "--fix-baseline",
+             "--baseline", BASELINE, "chainermn_tpu/"],
+            cwd=REPO, capture_output=True, text=True, env=env)
+        assert r.returncode == 0, r.stdout + r.stderr
+        try:
+            after = load_baseline(BASELINE)
+            assert set(after.entries) == set(before.entries), (
+                "partial --fix-baseline changed the entry set: "
+                f"lost={set(before.entries) - set(after.entries)} "
+                f"gained={set(after.entries) - set(before.entries)}")
+            for fp, e in after.entries.items():
+                assert e["comment"] == before.entries[fp]["comment"]
+        finally:
+            before.save(BASELINE)  # restore byte-stable shipped baseline
+
+    def test_rules_filter_does_not_hide_entrypoint_error(
+            self, monkeypatch, tmp_path):
+        # a broken entry point must fail the run even under --rules
+        import chainermn_tpu.analysis.entrypoints as eps_mod
+        from chainermn_tpu.analysis import cli as cli_mod
+        bad = _load_fixture_entrypoint("entrypoint_error", "bad")
+        monkeypatch.setattr(eps_mod, "ENTRYPOINTS", [bad])
+        clean_py = tmp_path / "clean.py"
+        clean_py.write_text("x = 1\n")
+        rc = cli_mod.main(["--rules", "unbound-axis", "--no-baseline",
+                           "--json", str(clean_py)])
+        assert rc == 1
+
+    def test_external_baseline_paths_stay_repo_relative(self, tmp_path):
+        # a baseline OUTSIDE the scanned tree must not bake "../<abs>"
+        # into fingerprints (location-independence promise)
+        env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+        script = os.path.join(REPO, "scripts", "lint_spmd.py")
+        bl = tmp_path / "bl.json"
+        bad_dir = os.path.join(FIXTURES, "prng_constant_key")
+        r = subprocess.run(
+            [sys.executable, script, "--no-jaxpr", "--fix-baseline",
+             "--baseline", str(bl), bad_dir],
+            cwd=REPO, capture_output=True, text=True, env=env)
+        assert r.returncode == 0, r.stdout + r.stderr
+        doc = json.loads(bl.read_text())
+        paths = [e["path"] for e in doc["findings"]]
+        assert paths and all(not p.startswith("..") for p in paths), paths
+
+    def test_rules_subset_and_unknown_rule(self):
+        env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+        script = os.path.join(REPO, "scripts", "lint_spmd.py")
+        r = subprocess.run([sys.executable, script, "--no-jaxpr",
+                            "--rules", "no-such-rule", "chainermn_tpu"],
+                           cwd=REPO, capture_output=True, text=True, env=env)
+        assert r.returncode == 2
+
+    def test_rule_catalog_complete(self):
+        assert set(AST_FIXTURE_DIRS) == set(AST_RULES)
+        assert set(JAXPR_FIXTURE_DIRS) == set(JAXPR_RULES)
