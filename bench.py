@@ -883,6 +883,17 @@ def main():
                              "atomically re-written after every section — "
                              "the perf-trajectory input that "
                              "scripts/check_perf_regression.py diffs")
+    parser.add_argument("--history-out", default="bench_history.jsonl",
+                        help="append ONE BENCH_r<N>-shaped record "
+                             "({n, cmd, rc, t, parsed}) per run to this "
+                             "JSONL trajectory; "
+                             "scripts/check_perf_regression.py --history "
+                             "gates the newest round against the previous "
+                             "one (empty string disables)")
+    parser.add_argument("--statusz-port", type=int, default=None,
+                        help="live introspection HTTP server (/statusz "
+                             "/metricsz /debugz) for watching a long "
+                             "bench run; 0 picks a free port")
     args = parser.parse_args()
 
     if args.scaling_worker is not None:
@@ -916,6 +927,15 @@ def main():
     if args.trace_out:
         from chainermn_tpu import observability as obs
         obs.enable()
+    statusz = None
+    if args.statusz_port is not None:
+        from chainermn_tpu.observability import introspect as _introspect
+        # /debugz?dump=1 needs somewhere to land: next to --json-out if
+        # given, else the repo's conventional result dir
+        dump_dir = (os.path.dirname(os.path.abspath(args.json_out))
+                    if args.json_out else "result")
+        statusz = _introspect.start_status_server(
+            args.statusz_port, dump_dir=dump_dir)
 
     dev = jax.devices()[0]
     on_tpu = dev.platform == "tpu"
@@ -1281,6 +1301,52 @@ def main():
         print("bench: over budget — scaling sweep skipped", file=sys.stderr)
 
     emit("final")
+
+    # --- bench trajectory: one BENCH_r<N>-shaped record per run ------------
+    # The committed BENCH_r*.json artifacts are driver-written; this is
+    # the SELF-written equivalent so every local/CI bench run extends the
+    # trajectory and `check_perf_regression.py --history` can gate round
+    # N against round N-1 without any driver (docs/PERF.md "trajectory
+    # loop").
+    if args.history_out:
+        try:
+            append_history(args.history_out, result)
+        except Exception as e:
+            print(f"bench: history append failed: {e!r}", file=sys.stderr)
+    if statusz is not None:
+        statusz.stop()
+
+
+def append_history(path, result, cmd=None):
+    """Append one ``{n, cmd, rc, t, parsed}`` record (the ``BENCH_r<N>
+    .json`` driver shape) to the JSONL trajectory at ``path``; ``n``
+    continues from the highest round already in the file.  Returns the
+    record."""
+    n = 0
+    if os.path.exists(path):
+        with open(path) as f:
+            for line in f:
+                if not line.strip():
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue  # torn tail from a killed run
+                if isinstance(rec, dict) and isinstance(rec.get("n"), int):
+                    n = max(n, rec["n"])
+    record = {
+        "n": n + 1,
+        "cmd": cmd or " ".join(sys.argv),
+        "rc": 0,
+        "t": round(time.time(), 3),
+        "parsed": result,
+    }
+    with open(path, "a") as f:
+        f.write(json.dumps(record) + "\n")
+        f.flush()
+    print(f"bench: trajectory round {record['n']} appended to {path}",
+          file=sys.stderr)
+    return record
 
 
 if __name__ == "__main__":

@@ -152,6 +152,97 @@ def _build_prefill_family() -> Dict[str, Any]:
                                (params, jnp.asarray(p3))])}
 
 
+class _traced_obs_state:
+    """Context manager: tracer enabled + flight tee installed for the
+    duration of ONE entry-point call, prior state restored after — an
+    analysis run must not leave process-global observability state
+    flipped on for whatever runs next (the lint tier shares its pytest
+    process with the whole suite)."""
+
+    def __enter__(self):
+        from chainermn_tpu import observability as obs
+        from chainermn_tpu.observability import flight
+        self._obs, self._flight = obs, flight
+        self._was_enabled = obs.enabled()
+        obs.enable()
+        flight.install_tracer_tee()
+        return self
+
+    def __exit__(self, *exc):
+        self._flight.uninstall_tracer_tee()
+        if not self._was_enabled:
+            self._obs.disable()
+        return False
+
+
+class _TracedVariantProbe:
+    """Wraps the variant jit function so every probe call runs under
+    the scoped tracer+tee state, while still exposing the underlying
+    ``_cache_size`` the recompile gate reads."""
+
+    def __init__(self, jfn):
+        self._jfn = jfn
+
+    def __call__(self, *a):
+        from chainermn_tpu import observability as obs
+        from chainermn_tpu.observability import flight
+        with _traced_obs_state():
+            with obs.span("serving/tick", cat="serving"):
+                out = self._jfn(*a)
+            flight.note("phase", name="serving/step")
+        return out
+
+    def _cache_size(self):
+        return self._jfn._cache_size()
+
+
+def _build_tick_with_tracing() -> Dict[str, Any]:
+    """The ISSUE 5 hazard this entry point pins down: the serving tick
+    with the TRACER ENABLED and the FLIGHT-RECORDER TEE installed must
+    still be ONE compiled program across value variants — observability
+    is host-side bookkeeping and must never leak into trace-time (a
+    tracer value captured into the jaxpr would both recompile per call
+    and be flagged as a tracer leak)."""
+    from chainermn_tpu import observability as obs
+    from chainermn_tpu.observability import flight
+
+    base = _build_decode_tick()
+    fn, args = base["trace"]
+
+    def run_traced(*a):
+        with _traced_obs_state():
+            with obs.span("serving/tick", cat="serving"):
+                out = fn(*a)
+            flight.note("phase", name="serving/step")
+        return out
+
+    jfn, variant_args = base["variants"]
+    return {"trace": (run_traced, args),
+            "bound_axes": base["bound_axes"],
+            "variants": (_TracedVariantProbe(jfn), variant_args)}
+
+
+def _build_flight_ring_program() -> Dict[str, Any]:
+    """Flight-recorder entry point: the accounted collective ring run
+    UNDER the ring tee (comm deltas -> flight events).  Guards the other
+    direction of the ISSUE 5 wiring — the accountant's flight tee fires
+    from host callbacks only, so the traced program's collective
+    sequence and compile count are byte-identical with the recorder
+    on."""
+    from chainermn_tpu.observability import flight
+
+    base = _build_collective_ring()
+    fn, args = base["trace"]
+
+    def run_teed(*a):
+        with _traced_obs_state():
+            out = fn(*a)
+            flight.note("phase", name="collective/ring")
+        return out
+
+    return {"trace": (run_teed, args), "bound_axes": base["bound_axes"]}
+
+
 ENTRYPOINTS = [
     EntryPoint(
         name="ops.collective.ring",
@@ -169,4 +260,18 @@ ENTRYPOINTS = [
         allow_recompile=True,
         description="per-prompt-length prefill programs (intentional "
                     "program family, see docs/SERVING.md)"),
+    EntryPoint(
+        name="serving.tick_with_tracing",
+        build=_build_tick_with_tracing,
+        description="serving decode tick with the tracer enabled and "
+                    "the flight-recorder tee installed — observability "
+                    "must stay host-side: one program, no tracer leak "
+                    "(ISSUE 5)"),
+    EntryPoint(
+        name="observability.flight_ring",
+        build=_build_flight_ring_program,
+        description="accounted collective ring under the flight-"
+                    "recorder comm tee — the ring records from host "
+                    "callbacks only, leaving the traced program "
+                    "unchanged (ISSUE 5)"),
 ]

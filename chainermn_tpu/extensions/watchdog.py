@@ -165,6 +165,18 @@ class Watchdog:
             tr.export_chrome_trace(trace_path, rank=self.rank)
             wrote.append(trace_path if self.rank is None else
                          "rank-sharded " + trace_path)
+        # Full debug bundle (flight ring + providers + env — ISSUE 5):
+        # the postmortem artifact scripts/explain_bundle.py renders.
+        from ..observability import flight as _flight
+        _flight.note("watchdog_abort", gap_s=round(gap, 1),
+                     timeout_s=self.timeout,
+                     last_phase=getattr(self._trainer, "last_phase", None))
+        bundle = _flight.dump_bundle(
+            out, "watchdog_abort", trainer=self._trainer,
+            monitor=self.monitor, rank=self.rank,
+            extra={"gap_s": round(gap, 1), "timeout_s": self.timeout})
+        if bundle is not None:
+            wrote.append(bundle)
         print(f"[chainermn_tpu watchdog] stall evidence written: "
               f"{', '.join(wrote)}", file=sys.stderr, flush=True)
 

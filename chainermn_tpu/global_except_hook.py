@@ -25,9 +25,28 @@ _installed = False
 _orig_hook = None
 
 
+def _flight_dump(exc_type, exc_value) -> None:
+    """Best-effort debug bundle before the process dies (flight recorder
+    — ISSUE 5).  Bounded side thread: the bundle writes files, and a
+    wedged filesystem must not turn the loud abort into a hang."""
+    import threading
+
+    def run():
+        try:
+            from .observability import flight
+            flight.dump_on_crash(exc_type, exc_value)
+        except Exception:
+            pass
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    t.join(timeout=10.0)
+
+
 def _global_except_hook(exc_type, exc_value, tb) -> None:
     import jax
 
+    _flight_dump(exc_type, exc_value)
     try:
         nproc = jax.process_count()
     except Exception:

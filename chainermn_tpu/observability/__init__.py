@@ -22,6 +22,20 @@ Fleet layer (ISSUE 2):
   (:class:`MetricsReport`) and the :func:`health_snapshot` dict the
   Watchdog dumps before aborting a stalled gang.
 
+Production triad (ISSUE 5):
+
+* :mod:`.flight` — black-box flight recorder: bounded ring of recent
+  structured events every emitter tees into, dumped as an atomic
+  versioned **debug bundle** on Watchdog abort / uncaught exception /
+  SIGTERM / SIGUSR1 (``scripts/explain_bundle.py`` renders it).
+* :mod:`.slo` — :class:`GoodputLedger` wall-time attribution
+  (compute/comm/host/compile/queue-wait/stall), :class:`SLOTracker`
+  multi-window burn-rate alerting, :class:`ReservoirSample` O(1)-memory
+  percentiles.
+* :mod:`.introspect` — live ``/statusz`` / ``/metricsz`` / ``/requestz``
+  / ``/debugz`` HTTP endpoint (``--statusz-port`` in the train/serve
+  CLIs and bench.py).
+
 Quick start::
 
     import chainermn_tpu as mn
@@ -34,12 +48,15 @@ Quick start::
 from .trace import (  # noqa: F401
     Tracer,
     add_counter,
+    async_event,
+    complete_event,
     disable,
     enable,
     enabled,
     export_chrome_trace,
     get_tracer,
     instant,
+    now_us,
     reset,
     set_gauge,
     span,
@@ -76,9 +93,31 @@ from .export import (  # noqa: F401
     MetricsReport,
     MetricsWriter,
     health_snapshot,
+    parse_prometheus_text,
     prometheus_text,
     read_metrics_jsonl,
     write_prometheus_textfile,
+)
+from .flight import (  # noqa: F401
+    BUNDLE_SCHEMA,
+    FlightRecorder,
+    dump_bundle,
+    find_bundles,
+    get_flight_recorder,
+    install_signal_handlers,
+    install_tracer_tee,
+    read_bundle,
+    register_provider,
+    set_crash_dump_dir,
+)
+from .slo import (  # noqa: F401
+    GoodputLedger,
+    ReservoirSample,
+    SLOTracker,
+)
+from .introspect import (  # noqa: F401
+    StatusServer,
+    start_status_server,
 )
 
 
@@ -133,5 +172,25 @@ __all__ = [
     "read_metrics_jsonl",
     "health_snapshot",
     "prometheus_text",
+    "parse_prometheus_text",
     "write_prometheus_textfile",
+    # flight recorder / SLO / introspection (ISSUE 5)
+    "BUNDLE_SCHEMA",
+    "FlightRecorder",
+    "get_flight_recorder",
+    "install_tracer_tee",
+    "install_signal_handlers",
+    "set_crash_dump_dir",
+    "register_provider",
+    "dump_bundle",
+    "read_bundle",
+    "find_bundles",
+    "GoodputLedger",
+    "ReservoirSample",
+    "SLOTracker",
+    "StatusServer",
+    "start_status_server",
+    "async_event",
+    "complete_event",
+    "now_us",
 ]

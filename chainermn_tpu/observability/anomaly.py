@@ -355,6 +355,9 @@ class HealthMonitor:
         tr = trace.get_tracer()
         tr.instant(f"anomaly/{finding['kind']}", cat="anomaly",
                    **{k: v for k, v in finding.items() if k != "kind"})
+        from . import flight as _flight
+        _flight.note("anomaly", finding_kind=finding["kind"],
+                     **{k: v for k, v in finding.items() if k != "kind"})
         line = dict(finding, ts=round(time.time(), 3))
         print(f"[chainermn_tpu health] {json.dumps(line, sort_keys=True)}",
               file=self._log or sys.stderr, flush=True)

@@ -141,6 +141,11 @@ class CommAccountant:
         tr = trace.get_tracer()
         tr.add_counter(f"comm/{op}/bytes", nbytes)
         tr.add_counter(f"comm/{op}/calls", 1)
+        # flight-recorder tee: one ring event per accounting delta, so a
+        # postmortem shows the last collectives the process completed
+        from . import flight as _flight
+        _flight.note("comm", op=op, axis=axis_key, bytes=int(nbytes),
+                     dtype=dtype, in_jit=bool(in_jit))
 
     # ---- per-step capture ----
     @contextmanager

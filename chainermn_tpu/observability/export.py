@@ -59,54 +59,163 @@ def _prom_name(name: str) -> str:
     return "chainermn_tpu_" + _PROM_BAD.sub("_", name).strip("_")
 
 
+def _esc_label(v) -> str:
+    """Prometheus label-value escaping: backslash, quote, newline — the
+    full exposition-format rule set, applied to EVERY label value."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _esc_help(v: str) -> str:
+    """HELP-text escaping: backslash and newline (quotes are legal)."""
+    return str(v).replace("\\", "\\\\").replace("\n", "\\n")
+
+
 def prometheus_text(extra_gauges: Optional[Dict[str, float]] = None) -> str:
     """Render the tracer's counters/gauges + the comm ledger in the
-    Prometheus text exposition format (version 0.0.4)."""
+    Prometheus text exposition format (version 0.0.4).
+
+    Per-family contract (the node-exporter parser's, verified by the
+    round-trip test): ONE ``# HELP`` and ONE ``# TYPE`` line per metric
+    family, immediately followed by all of that family's samples; label
+    values escaped per the exposition spec (backslash, quote, newline).
+    """
     tr = trace.get_tracer()
-    lines: List[str] = []
+    # family name -> (kind, help, [(labels-or-None, value), ...]);
+    # insertion-ordered so related families stay adjacent
+    families: Dict[str, list] = {}
 
-    def esc(v: str) -> str:
-        return str(v).replace("\\", "\\\\").replace('"', '\\"')
-
-    def emit(name: str, kind: str, value: float,
-             labels: Optional[Dict[str, str]] = None) -> None:
-        lines.append(f"# TYPE {name} {kind}")
-        lab = ""
-        if labels:
-            inner = ",".join(f'{k}="{esc(v)}"'
-                             for k, v in sorted(labels.items()))
-            lab = "{" + inner + "}"
-        lines.append(f"{name}{lab} {float(value)}")
+    def add(name: str, kind: str, help_text: str, value: float,
+            labels: Optional[Dict[str, str]] = None) -> None:
+        fam = families.setdefault(name, [kind, help_text, []])
+        fam[2].append((labels, float(value)))
 
     for name, total in sorted(tr.counters().items()):
-        emit(_prom_name(name) + "_total", "counter", total)
-    for name, value in sorted(tr.gauges().items()):
-        emit(_prom_name(name), "gauge", value)
-    for name, value in sorted((extra_gauges or {}).items()):
-        emit(_prom_name(name), "gauge", value)
+        add(_prom_name(name) + "_total", "counter",
+            f"cumulative total of tracer counter '{name}'", total)
+    # extra gauges OVERRIDE tracer gauges of the same name (the serving
+    # engine publishes e.g. serving/queue_depth both ways; duplicate
+    # unlabeled samples of one series are invalid exposition text)
+    gauges = dict(tr.gauges())
+    gauges.update(extra_gauges or {})
+    for name, value in sorted(gauges.items()):
+        add(_prom_name(name), "gauge",
+            f"instantaneous value of gauge '{name}'", value)
     spans = tr.summary()["spans"]
-    if spans:
-        for family, field, scale in (
-                ("chainermn_tpu_span_seconds_total", "total_ms", 1e-3),
-                ("chainermn_tpu_span_count_total", "count", 1.0)):
-            lines.append(f"# TYPE {family} counter")
-            for name, row in sorted(spans.items()):
-                lines.append(f'{family}{{name="{esc(name)}"}} '
-                             f"{float(row[field]) * scale}")
+    for family, field, scale, help_text in (
+            ("chainermn_tpu_span_seconds_total", "total_ms", 1e-3,
+             "cumulative wall seconds inside each tracer span"),
+            ("chainermn_tpu_span_count_total", "count", 1.0,
+             "number of closes of each tracer span")):
+        for name, row in sorted(spans.items()):
+            add(family, "counter", help_text, float(row[field]) * scale,
+                {"name": name})
     rep = get_accountant().report()
-    if rep["per_op"]:
-        # one TYPE line per family, then every labeled sample
-        for family, field in (("chainermn_tpu_comm_bytes_total", "bytes"),
-                              ("chainermn_tpu_comm_calls_total", "calls"),
-                              ("chainermn_tpu_comm_host_seconds_total",
-                               "host_time_s")):
-            lines.append(f"# TYPE {family} counter")
-            for key, row in sorted(rep["per_op"].items()):
-                op, _, axis = key.partition("@")
-                lab = f'{{axis="{esc(axis)}",op="{esc(op)}"}}'
-                lines.append(
-                    f"{family}{lab} {float(row.get(field, 0.0))}")
+    for family, field, help_text in (
+            ("chainermn_tpu_comm_bytes_total", "bytes",
+             "payload bytes moved per collective op and axis"),
+            ("chainermn_tpu_comm_calls_total", "calls",
+             "collective call count per op and axis"),
+            ("chainermn_tpu_comm_host_seconds_total", "host_time_s",
+             "host-observed seconds per collective op and axis")):
+        for key, row in sorted(rep["per_op"].items()):
+            op, _, axis = key.partition("@")
+            add(family, "counter", help_text,
+                float(row.get(field, 0.0)), {"axis": axis, "op": op})
+
+    lines: List[str] = []
+    for name, (kind, help_text, samples) in families.items():
+        lines.append(f"# HELP {name} {_esc_help(help_text)}")
+        lines.append(f"# TYPE {name} {kind}")
+        for labels, value in samples:
+            lab = ""
+            if labels:
+                inner = ",".join(f'{k}="{_esc_label(v)}"'
+                                 for k, v in sorted(labels.items()))
+                lab = "{" + inner + "}"
+            lines.append(f"{name}{lab} {value}")
     return "\n".join(lines) + "\n"
+
+
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>.*)\})?"
+    r"\s+(?P<value>[^\s]+)(?:\s+\d+)?$")
+_LABEL_RE = re.compile(
+    r'\s*(?P<k>[a-zA-Z_][a-zA-Z0-9_]*)="(?P<v>(?:\\.|[^"\\])*)"\s*(,|$)')
+
+
+def parse_prometheus_text(text: str) -> Dict[str, Any]:
+    """Strict parser for the exposition subset this repo emits.
+
+    Validates the per-family contract — every sample's family has a
+    ``# TYPE`` (and ``# HELP``) line ABOVE it, label syntax is legal,
+    values parse as floats — raising ``ValueError`` with the offending
+    line otherwise.  Returns ``{"families": {name: {"type", "help"}},
+    "samples": [(name, labels, value), ...]}`` with label values
+    UN-escaped (the round-trip test's oracle).
+    """
+    families: Dict[str, Dict[str, str]] = {}
+    samples: List[tuple] = []
+    seen_series: set = set()
+    for i, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        if line.startswith("# HELP "):
+            parts = line.split(None, 3)
+            if len(parts) < 3:
+                raise ValueError(f"line {i}: malformed HELP: {line!r}")
+            name = parts[2]
+            families.setdefault(name, {})["help"] = (
+                parts[3] if len(parts) > 3 else "")
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split()
+            if len(parts) != 4 or parts[3] not in (
+                    "counter", "gauge", "histogram", "summary", "untyped"):
+                raise ValueError(f"line {i}: malformed TYPE: {line!r}")
+            families.setdefault(parts[2], {})["type"] = parts[3]
+            continue
+        if line.startswith("#"):
+            continue  # comment
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            raise ValueError(f"line {i}: unparseable sample: {line!r}")
+        name = m.group("name")
+        base = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix) and name[: -len(suffix)] in families:
+                base = name[: -len(suffix)]
+        if base not in families or "type" not in families[base]:
+            raise ValueError(
+                f"line {i}: sample {name!r} has no preceding # TYPE line")
+        labels: Dict[str, str] = {}
+        raw = m.group("labels")
+        if raw:
+            pos = 0
+            while pos < len(raw):
+                lm = _LABEL_RE.match(raw, pos)
+                if not lm:
+                    raise ValueError(
+                        f"line {i}: malformed labels {raw!r}")
+                labels[lm.group("k")] = re.sub(
+                    r"\\(.)",
+                    lambda e: {"n": "\n"}.get(e.group(1), e.group(1)),
+                    lm.group("v"))
+                pos = lm.end()
+        try:
+            value = float(m.group("value"))
+        except ValueError:
+            raise ValueError(
+                f"line {i}: non-numeric sample value: {line!r}")
+        series = (name, tuple(sorted(labels.items())))
+        if series in seen_series:
+            raise ValueError(
+                f"line {i}: duplicate series {name}{labels!r} — "
+                "Prometheus rejects scrapes with repeated samples")
+        seen_series.add(series)
+        samples.append((name, labels, value))
+    return {"families": families, "samples": samples}
 
 
 def write_prometheus_textfile(path: str,

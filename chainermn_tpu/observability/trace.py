@@ -90,8 +90,7 @@ class _Span:
               "pid": tr._pid, "tid": tr._tid()}
         if self.args:
             ev["args"] = self.args
-        with tr._lock:
-            tr._append(ev)
+        tr._commit(ev)
         return False
 
 
@@ -119,6 +118,10 @@ class Tracer:
         self._tids: Dict[int, int] = {}
         self._pid = os.getpid()
         self._epoch_ns = time.perf_counter_ns()
+        # Event sinks (the flight-recorder tee): called with every
+        # appended event dict, OUTSIDE the buffer lock.  A sink must be
+        # cheap and must never call back into the tracer.
+        self._sinks: List[Callable[[Dict[str, Any]], None]] = []
 
     # ---- lifecycle ----
     def enable(self) -> None:
@@ -159,6 +162,34 @@ class Tracer:
             self._dropped += 1
             return
         self._events.append(ev)
+
+    def _commit(self, ev: Dict[str, Any]) -> None:
+        """Buffer ``ev`` (under the lock), then fan it out to any
+        registered sinks (outside the lock — a sink taking its own lock
+        must never nest inside ours)."""
+        with self._lock:
+            self._append(ev)
+        for sink in self._sinks:
+            try:
+                sink(ev)
+            except Exception:
+                pass  # a broken tee must never break tracing itself
+
+    def add_sink(self, sink: Callable[[Dict[str, Any]], None]) -> None:
+        """Register an event tee (e.g. the flight recorder); idempotent
+        per callable."""
+        if sink not in self._sinks:
+            self._sinks.append(sink)
+
+    def remove_sink(self, sink: Callable[[Dict[str, Any]], None]) -> None:
+        if sink in self._sinks:
+            self._sinks.remove(sink)
+
+    def now_us(self) -> int:
+        """Public face of the tracer clock (µs since this tracer's
+        epoch) — for callers recording retrospective spans via
+        :meth:`complete_event`."""
+        return self._now_us()
 
     # ---- recording surface ----
     def span(self, name: str, cat: str = "span", **args):
@@ -202,6 +233,8 @@ class Tracer:
                 "name": name, "ph": "C", "ts": self._now_us(),
                 "pid": self._pid, "tid": 0,
                 "args": {name.rsplit("/", 1)[-1]: total}})
+        # counters are too hot for the tee: flight consumers read the
+        # comm ledger's deltas instead (observability.comm tees those)
         return total
 
     def set_gauge(self, name: str, value: float) -> None:
@@ -223,8 +256,38 @@ class Tracer:
               "ts": self._now_us(), "pid": self._pid, "tid": self._tid()}
         if args:
             ev["args"] = args
-        with self._lock:
-            self._append(ev)
+        self._commit(ev)
+
+    def complete_event(self, name: str, t0_us: int, dur_us: int,
+                       cat: str = "span", **args) -> None:
+        """Record a RETROSPECTIVE span from explicit tracer-clock stamps
+        (see :meth:`now_us`) — e.g. a request's queue-wait, whose start
+        was observed before anyone knew whether it would be admitted."""
+        if not self.enabled:
+            return
+        ev = {"name": name, "cat": cat, "ph": "X", "ts": int(t0_us),
+              "dur": max(int(dur_us), 0), "pid": self._pid,
+              "tid": self._tid()}
+        if args:
+            ev["args"] = args
+        self._commit(ev)
+
+    def async_event(self, ph: str, name: str, async_id, cat: str = "flow",
+                    ts_us: Optional[int] = None, **args) -> None:
+        """Chrome ASYNC event (``ph`` in ``b``/``n``/``e``): all events
+        sharing ``(cat, id)`` render as one flow track in Perfetto —
+        the per-request lane keyed by trace id.  ``ts_us`` overrides the
+        stamp for retrospective emission."""
+        if not self.enabled:
+            return
+        ev = {"name": name, "cat": cat, "ph": ph, "id": str(async_id),
+              "ts": self._now_us() if ts_us is None else int(ts_us),
+              "pid": self._pid, "tid": self._tid()}
+        if ph == "n":
+            ev["s"] = "t"
+        if args:
+            ev["args"] = args
+        self._commit(ev)
 
     # ---- read-out ----
     def events(self) -> List[Dict[str, Any]]:
@@ -338,6 +401,20 @@ def traced(name: Optional[str] = None, cat: str = "span"):
 
 def instant(name: str, cat: str = "instant", **args) -> None:
     _GLOBAL.instant(name, cat=cat, **args)
+
+
+def complete_event(name: str, t0_us: int, dur_us: int,
+                   cat: str = "span", **args) -> None:
+    _GLOBAL.complete_event(name, t0_us, dur_us, cat=cat, **args)
+
+
+def async_event(ph: str, name: str, async_id, cat: str = "flow",
+                ts_us: Optional[int] = None, **args) -> None:
+    _GLOBAL.async_event(ph, name, async_id, cat=cat, ts_us=ts_us, **args)
+
+
+def now_us() -> int:
+    return _GLOBAL.now_us()
 
 
 def add_counter(name: str, value: float = 1.0) -> float:

@@ -84,6 +84,20 @@ def main(argv=None):
     parser.add_argument("--trace-out", default=None,
                         help="enable the tracer; Chrome-trace JSON with the "
                              "per-request serving spans/instants")
+    parser.add_argument("--statusz-port", type=int, default=None,
+                        help="start the live introspection HTTP server "
+                             "(/statusz /metricsz /requestz /debugz) on "
+                             "this port; 0 picks a free port (printed to "
+                             "stderr)")
+    parser.add_argument("--flight-dump-dir", default=None,
+                        help="enable the flight recorder's crash bundles: "
+                             "SIGTERM/SIGUSR1/uncaught exceptions dump a "
+                             "debug bundle into this directory")
+    parser.add_argument("--ttft-slo-ms", type=float, default=None,
+                        help="TTFT SLO target; enables the multi-window "
+                             "burn-rate tracker")
+    parser.add_argument("--tps-slo", type=float, default=None,
+                        help="tokens/sec SLO target for the burn tracker")
     args = parser.parse_args(argv)
 
     if args.devices:
@@ -108,6 +122,13 @@ def main(argv=None):
 
     if args.trace_out:
         obs.enable()
+    # flight recorder: always on (bounded ring, negligible cost); crash
+    # bundles + signal handlers only when a dump dir is configured
+    obs.install_tracer_tee()
+    if args.flight_dump_dir:
+        from chainermn_tpu import global_except_hook
+        obs.install_signal_handlers(args.flight_dump_dir)
+        global_except_hook.add_hook()
 
     n = len(jax.devices())
     if n % args.tp:
@@ -149,11 +170,22 @@ def main(argv=None):
     if args.metrics_out:
         from chainermn_tpu.observability.export import MetricsWriter
         writer = MetricsWriter(args.metrics_out)
+    slo = None
+    if args.ttft_slo_ms is not None or args.tps_slo is not None:
+        from chainermn_tpu.observability.slo import SLOTracker
+        slo = SLOTracker(ttft_target_ms=args.ttft_slo_ms,
+                         tokens_per_sec_target=args.tps_slo)
     eng = ServingEngine(
         trained, head_dim=head_dim, n_slots=args.n_slots,
         max_total=args.max_total or max(total_len, 8),
         mesh=serve_mesh, queue_capacity=args.queue_capacity,
-        metrics_writer=writer)
+        metrics_writer=writer, slo=slo)
+    statusz = None
+    if args.statusz_port is not None:
+        statusz = obs.start_status_server(
+            args.statusz_port, extra_gauges=eng.metrics,
+            requests_fn=eng.requests_table,
+            dump_dir=args.flight_dump_dir)
 
     test = make_corpus(np.random.RandomState(99), args.requests,
                        max(args.seq_len, total_len), args.vocab)
@@ -219,6 +251,7 @@ def main(argv=None):
               f"(true continuation {want[i].tolist()})", file=sys.stderr)
 
     metrics = eng.metrics()
+    goodput = eng.goodput.report()
     if writer is not None:
         eng.finalize_metrics()
         writer.close()
@@ -226,6 +259,9 @@ def main(argv=None):
         eng.write_prometheus(args.prom_out)
     if args.trace_out:
         obs.export_chrome_trace(args.trace_out)
+    if statusz is not None:
+        statusz.stop()
+    eng.close()
     summary = {
         "schema": "chainermn_tpu.serve.v1",
         "engine_steps": steps,
@@ -233,7 +269,10 @@ def main(argv=None):
         "mean_continuation_accuracy": (
             round(float(np.mean(correct)), 3) if correct else None),
         "metrics": {k: round(float(v), 3) for k, v in metrics.items()},
+        "goodput": goodput,
     }
+    if slo is not None:
+        summary["slo"] = slo.status()
     print(json.dumps(summary))
     return 0
 

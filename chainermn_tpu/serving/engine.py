@@ -88,6 +88,12 @@ class DecodeEngine:
                              for _ in range(pool.n_layers)]
         self._prefill_progs = {}   # padded prompt length -> compiled fn
         self._tick_prog = self._build_tick()
+        # program/compile accounting (flight bundles + /statusz report
+        # these: a growing prefill-family or a tick_calls≈compile count
+        # mismatch is the recompile postmortem signal)
+        self.prefill_compiles = 0
+        self.prefill_calls = 0
+        self.tick_calls = 0
 
     # ---- program builders ----
     def _build_tick(self):
@@ -169,6 +175,12 @@ class DecodeEngine:
         prog = self._prefill_progs.get(s_pad)
         if prog is None:
             prog = self._prefill_progs[s_pad] = self._build_prefill(s_pad)
+            self.prefill_compiles += 1
+            from ..observability import flight as _flight
+            _flight.note("compile", program="serving_prefill",
+                         padded_len=s_pad,
+                         family_size=len(self._prefill_progs))
+        self.prefill_calls += 1
         tok, self.pool.caches = prog(
             self._params, self.pool.caches, jnp.asarray(prompt),
             jnp.int32(s_real), jnp.int32(slot))
@@ -182,6 +194,7 @@ class DecodeEngine:
         slot (the caller keeps only the active rows)."""
         import jax.numpy as jnp
 
+        self.tick_calls += 1
         tokens = jnp.asarray(np.array(last_tokens, np.int32, copy=True))
         # COPY at the jax boundary: on CPU ``jnp.asarray`` may zero-copy
         # alias the host buffer, and dispatch is ASYNC — an in-place

@@ -17,19 +17,37 @@ therefore join and leave between ticks — a late submit starts decoding
 as soon as a slot frees, while earlier sequences keep running
 (iteration-level / continuous batching).
 
-Observability (the PR 1/2 substrate, docs/OBSERVABILITY.md):
+Observability (the PR 1/2 substrate + the ISSUE 5 production triad,
+docs/OBSERVABILITY.md):
 
 * per-request PHASE TIMESTAMPS on the handle (``submitted``,
   ``prefill_start``, ``first_token``, ``finished``) — the span data the
   integration test asserts on — mirrored into the tracer as
   ``serving/request/*`` instants (+ a real ``serving/prefill`` /
   ``serving/tick`` span around each device call) when tracing is on;
+* **per-request distributed tracing**: every request carries a
+  ``trace_id``; queue-wait / prefill / each decode tick become REAL
+  tracer spans carrying it, plus one Chrome async flow (``cat
+  "serving_request"``, ``id`` = trace id) from submit to finish — so a
+  request renders as its own lane in the PR 2 merged Perfetto doc;
+* **goodput attribution**: a :class:`~chainermn_tpu.observability.slo
+  .GoodputLedger` partitions the engine's wall clock into compute /
+  compile / host / queue-wait / stall buckets (sums match wall within
+  5% — the acceptance gate), reported via :meth:`metrics`;
+* **SLO tracking**: an optional :class:`~chainermn_tpu.observability
+  .slo.SLOTracker` observes every TTFT and the rolling tokens/s, firing
+  multi-window burn-rate findings down the PR 2 anomaly path;
+* **flight recorder**: admissions, evictions, expiries, errors, and
+  engine phases tee into the ring, and the engine registers a
+  ``serving`` state provider so every debug bundle / ``/statusz`` hit
+  carries live queue/slot/request state;
 * serving GAUGES through the tracer (``serving/queue_depth``,
   ``serving/active_slots``, ``serving/tokens_per_sec``) so
   ``observability.export.write_prometheus_textfile`` scrapes them with
   everything else, plus :meth:`ServingEngine.metrics` (TTFT p50/p99,
-  per-token latency, slot occupancy) as the ``extra_gauges`` /
-  bench-section payload;
+  per-token latency, slot occupancy — O(1)-memory reservoir samples,
+  never unbounded lists) as the ``extra_gauges`` / bench-section
+  payload;
 * optional per-step JSONL via ``observability.export.MetricsWriter``
   (kind ``serving_step`` records + one ``serving_summary``), the
   ``scripts/check_perf_regression.py``-gateable stream.
@@ -40,11 +58,14 @@ from __future__ import annotations
 import sys
 import threading
 import time
-from typing import Callable, Dict, List, Optional
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
 
 from .. import observability as obs
+from ..observability import flight as _flight
+from ..observability.slo import GoodputLedger, ReservoirSample, SLOTracker
 from .cache_pool import CachePool
 from .engine import DecodeEngine
 from .scheduler import AdmissionError, Request, Scheduler
@@ -59,6 +80,10 @@ class RequestHandle:
     @property
     def id(self) -> int:
         return self._req.id
+
+    @property
+    def trace_id(self) -> str:
+        return self._req.trace_id
 
     @property
     def status(self) -> str:
@@ -88,10 +113,24 @@ class RequestHandle:
         return self._req.done_event.wait(timeout)
 
 
-def _percentile(values: List[float], q: float) -> Optional[float]:
-    if not values:
-        return None
-    return float(np.percentile(np.asarray(values, np.float64), q))
+def _request_row(req: Request) -> Dict[str, Any]:
+    """One JSON-able /requestz row (also the bundle's serving view)."""
+    ts = dict(req.timestamps)
+    row = {
+        "id": req.id,
+        "trace_id": req.trace_id,
+        "status": req.status,
+        "finish_reason": req.finish_reason,
+        "slot": req.slot,
+        "prompt_len": req.prompt_len,
+        "max_new_tokens": req.max_new_tokens,
+        "n_tokens": len(req.tokens),
+        "timestamps": {k: round(v, 6) for k, v in ts.items()},
+    }
+    if "submitted" in ts and "first_token" in ts:
+        row["ttft_ms"] = round(
+            (ts["first_token"] - ts["submitted"]) * 1e3, 3)
+    return row
 
 
 class ServingEngine:
@@ -108,7 +147,10 @@ class ServingEngine:
     def __init__(self, params, *, head_dim: int, n_slots: int = 4,
                  max_total: int = 128, mesh=None, axis_name: str = "model",
                  queue_capacity: int = 16, max_prefills_per_tick: int = 1,
-                 prefill_bucket: int = 1, metrics_writer=None):
+                 prefill_bucket: int = 1, metrics_writer=None,
+                 stats_capacity: int = 1024,
+                 slo: Optional[SLOTracker] = None,
+                 recent_capacity: int = 64):
         from ..parallel.decode import _kv_heads
 
         n_kv = _kv_heads(params, head_dim)
@@ -132,14 +174,33 @@ class ServingEngine:
         self._lock = threading.Lock()            # guards _running + stats
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
-        # rolling stats (host floats only)
-        self._ttft_ms: List[float] = []
-        self._tok_lat_ms: List[float] = []
+        # rolling stats (host floats only).  Latency percentiles come
+        # from FIXED-SIZE reservoirs, not unbounded lists: metrics() is
+        # O(1) memory however long the serve loop runs (ISSUE 5).
+        self.stats_capacity = int(stats_capacity)
+        self._ttft_ms = ReservoirSample(self.stats_capacity)
+        self._tok_lat_ms = ReservoirSample(self.stats_capacity)
         self._tokens_emitted = 0
         self._ticks = 0
         self._occupancy_sum = 0.0
         self._rejected = 0
         self._t0 = time.monotonic()
+        # goodput attribution: step() partitions its own wall clock, and
+        # the gap between steps books as queue_wait (work was waiting)
+        # or stall (engine idle) — sums reconcile against wall within 5%
+        self.goodput = GoodputLedger()
+        self._last_step_end: Optional[float] = None
+        self.slo = slo
+        # last SLO throughput observation point (tokens, monotonic t):
+        # the tracker must see the RECENT rate, not the run-cumulative
+        # average a long healthy history would pin above any target
+        self._slo_last = (0, self._t0)
+        # recently finished requests for /requestz and the debug bundle
+        self._recent: deque = deque(maxlen=int(recent_capacity))
+        # flight provider: every bundle / statusz hit carries live
+        # queue/slot/request state (survives because dump reads it at
+        # crash time, not at construction time)
+        _flight.register_provider("serving", self.introspect_state)
 
     # ---- submission ----
     def submit(self, prompt, max_new_tokens: int, *,
@@ -158,6 +219,15 @@ class ServingEngine:
                       deadline_t=(now + deadline_s
                                   if deadline_s is not None else None),
                       on_token=on_token)
+        # tracer-clock stamp + flow BEGIN before the request becomes
+        # visible to the scheduler: with start()'s driver thread, a
+        # request can be admitted (even finished) the instant submit()
+        # publishes it, and a later 'b' event would postdate its own
+        # 'n'/'e' — the queue-wait span reads trace_us at admission
+        req.trace_us = {"submitted": obs.now_us()}
+        obs.async_event("b", "request", req.trace_id,
+                        cat="serving_request", request=req.id,
+                        prompt_len=req.prompt_len)
         try:
             # the PADDED prefill length is what must fit the slot (and
             # the learned-pos table) — the scheduler only knows raw
@@ -173,11 +243,21 @@ class ServingEngine:
                     f"(prefill_bucket {self.engine.prefill_bucket}), "
                     f"exceeding per-slot capacity {cap}")
             self.scheduler.submit(req, now)
-        except AdmissionError:
+        except AdmissionError as e:
             with self._lock:
                 self._rejected += 1
+            # close the flow we opened: a rejected request must not
+            # leave a dangling async lane
+            obs.async_event("e", "request", req.trace_id,
+                            cat="serving_request", reason="rejected",
+                            admission_reason=e.reason)
+            _flight.note("serving", event="rejected", request=req.id,
+                         trace_id=req.trace_id, reason=e.reason)
             raise
-        obs.instant("serving/request/queued", cat="serving", request=req.id)
+        obs.instant("serving/request/queued", cat="serving",
+                    request=req.id, trace_id=req.trace_id)
+        _flight.note("serving", event="queued", request=req.id,
+                     trace_id=req.trace_id, prompt_len=req.prompt_len)
         obs.set_gauge("serving/queue_depth", self.scheduler.queue_depth)
         return RequestHandle(req)
 
@@ -185,11 +265,26 @@ class ServingEngine:
     def step(self) -> Dict[str, float]:
         """ONE engine iteration: expire → admit/prefill → tick → evict.
         Returns host-side stats for the iteration (also streamed to the
-        JSONL metrics writer when configured)."""
+        JSONL metrics writer when configured).
+
+        Goodput attribution: the whole iteration's wall clock lands in
+        ledger buckets — prefill/tick device calls as ``compute`` (or
+        ``compile`` on a call that built a new program), everything
+        around them as ``host``, and the gap since the previous step as
+        ``queue_wait`` (work was waiting) or ``stall`` (idle)."""
+        t_step0 = time.monotonic()
+        if self._last_step_end is not None:
+            gap = t_step0 - self._last_step_end
+            had_work = (self.scheduler.queue_depth > 0
+                        or self.pool.busy_count > 0)
+            self.goodput.add("queue_wait" if had_work else "stall", gap)
+        t_host = t_step0                       # start of current host segment
+
         now = time.monotonic()
         for req in self.scheduler.expire_queued(now):
             obs.instant("serving/request/expired", cat="serving",
-                        request=req.id)
+                        request=req.id, trace_id=req.trace_id)
+            self._finish_tracing(req, "deadline")
 
         # admit up to the interleave bound into free slots
         for req in self.scheduler.admissions(self.pool.free_count, now):
@@ -197,14 +292,38 @@ class ServingEngine:
             assert slot is not None  # admissions() is bounded by free_count
             req.slot = slot
             req.status = "running"
-            req.timestamps["prefill_start"] = now
+            t_admit = time.monotonic()
+            req.timestamps["prefill_start"] = t_admit
+            # the queue-wait span, retrospectively: submit → this admit
+            t_us = getattr(req, "trace_us", None)
+            if t_us is not None:
+                now_us = obs.now_us()
+                obs.complete_event(
+                    "request/queue_wait", t_us["submitted"],
+                    now_us - t_us["submitted"], cat="serving_request",
+                    trace_id=req.trace_id, request=req.id)
             obs.instant("serving/request/prefill", cat="serving",
-                        request=req.id, slot=slot)
+                        request=req.id, slot=slot, trace_id=req.trace_id)
+            _flight.note("serving", event="admitted", request=req.id,
+                         trace_id=req.trace_id, slot=slot)
             try:
-                with obs.span("serving/prefill", cat="serving",
-                              request=req.id):
-                    first = self.engine.prefill_into_slot(req.prompt, slot)
+                self.goodput.add("host", t_admit - t_host)
+                compiles_before = self.engine.prefill_compiles
+                t_pf = time.monotonic()
+                with obs.span("serving/prefill", cat="serving_request",
+                              request=req.id, trace_id=req.trace_id,
+                              slot=slot):
+                    first = self.engine.prefill_into_slot(
+                        req.prompt, slot)
+                t_host = time.monotonic()
+                # the engine's own counter says whether THIS call built
+                # a new program — no probing of its cache internals
+                self.goodput.add(
+                    "compile" if self.engine.prefill_compiles
+                    > compiles_before else "compute", t_host - t_pf)
             except Exception as e:
+                t_host = time.monotonic()
+                self.goodput.add("compute", t_host - t_pf)
                 # never die holding a slot: a failed prefill (engine bug,
                 # OOM, ...) releases the slot and fails THIS request only
                 # — with start() an escaping exception would kill the
@@ -213,7 +332,10 @@ class ServingEngine:
                 self.pool.release(slot)
                 req.finish("error", time.monotonic())
                 obs.instant("serving/request/error", cat="serving",
-                            request=req.id)
+                            request=req.id, trace_id=req.trace_id)
+                _flight.note("serving", event="error", request=req.id,
+                             trace_id=req.trace_id, error=repr(e))
+                self._finish_tracing(req, "error")
                 print(f"chainermn_tpu.serving: prefill of request "
                       f"{req.id} failed: {e!r}", file=sys.stderr)
                 continue
@@ -230,14 +352,27 @@ class ServingEngine:
             for slot, req in active.items():
                 tokens[slot] = req.tokens[-1]
             t_tick = time.monotonic()
+            self.goodput.add("host", t_tick - t_host)
+            tick_bucket = ("compile" if self.engine.tick_calls == 0
+                           else "compute")
+            t_tick_us = obs.now_us()
             with obs.span("serving/tick", cat="serving",
                           active=len(active)):
-                nxt = self.engine.tick(tokens)
-            dt_ms = (time.monotonic() - t_tick) * 1e3
+                with self.goodput.measure(tick_bucket):
+                    nxt = self.engine.tick(tokens)
+            t_host = time.monotonic()
+            dt_ms = (t_host - t_tick) * 1e3
+            dt_us = obs.now_us() - t_tick_us
             now = time.monotonic()
             for slot, req in active.items():
+                # per-request decode-tick span, nested under the engine
+                # tick on the timeline and keyed by the trace id
+                obs.complete_event(
+                    "request/decode_tick", t_tick_us, dt_us,
+                    cat="serving_request", trace_id=req.trace_id,
+                    request=req.id, slot=slot, active=len(active))
                 self._emit(req, int(nxt[slot]), now)
-                self._tok_lat_ms.append(dt_ms / max(len(active), 1))
+                self._tok_lat_ms.add(dt_ms / max(len(active), 1))
                 self._maybe_evict(req, now)
 
         with self._lock:
@@ -254,10 +389,28 @@ class ServingEngine:
         if el > 0:
             obs.set_gauge("serving/tokens_per_sec",
                           self._tokens_emitted / el)
+        if self.slo is not None and active:
+            # per-step instantaneous rate: tokens since the previous
+            # observation over the elapsed gap (idle steps don't count
+            # — zero demand is not an SLO violation)
+            last_tok, last_t = self._slo_last
+            now_t = time.monotonic()
+            dt = now_t - last_t
+            if dt > 0:
+                self.slo.observe_throughput(
+                    (self._tokens_emitted - last_tok) / dt)
+            self._slo_last = (self._tokens_emitted, now_t)
         if self.metrics_writer is not None:
             self.metrics_writer.write(
                 {f"serving/{k}": v for k, v in stats.items()},
                 kind="serving_step")
+        t_end = time.monotonic()
+        self.goodput.add("host", t_end - t_host)
+        self._last_step_end = t_end
+        # phase stamp: the ring's "last completed unit of work" marker
+        # (what explain_bundle names when a serve loop dies mid-flight)
+        _flight.note("phase", name="serving/step", tick=self._ticks,
+                     active=int(stats["active_slots"]))
         return stats
 
     def _emit(self, req: Request, token: int, now: float) -> None:
@@ -266,14 +419,30 @@ class ServingEngine:
             req.timestamps["first_token"] = now
             ttft = (now - req.timestamps["submitted"]) * 1e3
             with self._lock:
-                self._ttft_ms.append(ttft)
+                self._ttft_ms.add(ttft)
+            if self.slo is not None:
+                self.slo.observe_ttft(ttft)
             obs.instant("serving/request/first_token", cat="serving",
-                        request=req.id)
+                        request=req.id, trace_id=req.trace_id)
+            obs.async_event("n", "first_token", req.trace_id,
+                            cat="serving_request",
+                            ttft_ms=round(ttft, 3))
         with self._lock:
             self._tokens_emitted += 1
         obs.add_counter("serving/tokens_total", 1)
         if req.on_token is not None:
             req.on_token(int(token), req.id)
+
+    def _finish_tracing(self, req: Request, reason: str) -> None:
+        """Close the request's async flow + tee the terminal event."""
+        obs.async_event("e", "request", req.trace_id,
+                        cat="serving_request", reason=reason,
+                        n_tokens=len(req.tokens))
+        _flight.note("serving", event="finished", request=req.id,
+                     trace_id=req.trace_id, reason=reason,
+                     n_tokens=len(req.tokens))
+        with self._lock:
+            self._recent.append(req)
 
     def _maybe_evict(self, req: Request, now: float) -> None:
         reason = self.scheduler.eviction_reason(req, now)
@@ -285,7 +454,8 @@ class ServingEngine:
             self._running.pop(slot, None)
         self.pool.release(slot)
         obs.instant("serving/request/complete", cat="serving",
-                    request=req.id, reason=reason)
+                    request=req.id, reason=reason, trace_id=req.trace_id)
+        self._finish_tracing(req, reason)
 
     # ---- driving ----
     def run(self, steps_budget: Optional[int] = None,
@@ -333,6 +503,15 @@ class ServingEngine:
             self._thread.join(timeout=10)
             self._thread = None
 
+    def close(self) -> None:
+        """Retire the engine: stop the driver thread and drop the
+        flight/statusz provider registration (which otherwise pins the
+        engine — params + KV pool — for the process lifetime and would
+        report this dead engine's state as live)."""
+        self.stop()
+        if _flight._PROVIDERS.get("serving") == self.introspect_state:
+            _flight.unregister_provider("serving")
+
     # ---- metrics ----
     def reset_stats(self) -> None:
         """Zero the rolling serving stats and restart the throughput
@@ -340,12 +519,15 @@ class ServingEngine:
         don't absorb one-off costs (bench.py's serving section does)."""
         with self._lock:
             self._t0 = time.monotonic()
-            self._ttft_ms = []
-            self._tok_lat_ms = []
+            self._ttft_ms = ReservoirSample(self.stats_capacity)
+            self._tok_lat_ms = ReservoirSample(self.stats_capacity)
             self._tokens_emitted = 0
             self._ticks = 0
             self._occupancy_sum = 0.0
             self._rejected = 0
+            self.goodput.reset()
+            self._last_step_end = None
+            self._slo_last = (0, self._t0)
 
     def metrics(self) -> Dict[str, float]:
         """Host-side serving summary (the Prometheus ``extra_gauges`` /
@@ -364,14 +546,53 @@ class ServingEngine:
                     self._occupancy_sum / self._ticks if self._ticks
                     else 0.0),
             }
-            for name, vals in (("ttft", self._ttft_ms),
-                               ("token_latency", self._tok_lat_ms)):
-                p50 = _percentile(vals, 50)
-                p99 = _percentile(vals, 99)
+            for name, res in (("ttft", self._ttft_ms),
+                              ("token_latency", self._tok_lat_ms)):
+                p50 = res.percentile(50)
+                p99 = res.percentile(99)
                 if p50 is not None:
                     out[f"serving/{name}_p50_ms"] = p50
                     out[f"serving/{name}_p99_ms"] = p99
+        out.update(self.goodput.gauges("serving/goodput"))
         return out
+
+    # ---- live introspection (/requestz, /statusz, debug bundles) ----
+    def requests_table(self) -> Dict[str, Any]:
+        """Queued + running + recently finished requests with their
+        trace ids and phase timestamps (the /requestz payload)."""
+        with self._lock:
+            running = [_request_row(r) for r in self._running.values()]
+            recent = [_request_row(r) for r in self._recent]
+        return {
+            "schema": "chainermn_tpu.requestz.v1",
+            "queued": [_request_row(r)
+                       for r in self.scheduler.queued_requests()],
+            "running": running,
+            "recent": list(reversed(recent)),  # newest first
+        }
+
+    def introspect_state(self) -> Dict[str, Any]:
+        """The ``serving`` flight/statusz provider: engine config, slot
+        and queue occupancy, compile counts, goodput, SLO state, and
+        the request table — everything a postmortem asks first."""
+        state: Dict[str, Any] = {
+            "n_slots": self.pool.n_slots,
+            "max_total": self.pool.max_total,
+            "busy_slots": self.pool.busy_count,
+            "free_slots": self.pool.free_count,
+            "queue_depth": self.scheduler.queue_depth,
+            "queue_capacity": self.scheduler.queue_capacity,
+            "ticks": self._ticks,
+            "tokens_emitted": self._tokens_emitted,
+            "rejected": self._rejected,
+            "prefill_compiles": self.engine.prefill_compiles,
+            "tick_calls": self.engine.tick_calls,
+            "goodput": self.goodput.report(),
+            "requests": self.requests_table(),
+        }
+        if self.slo is not None:
+            state["slo"] = self.slo.status()
+        return state
 
     def write_prometheus(self, path: str) -> str:
         """Atomic Prometheus textfile: tracer counters/gauges + the

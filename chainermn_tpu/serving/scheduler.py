@@ -27,6 +27,7 @@ Policy (deliberately simple and inspectable; knobs in docs/SERVING.md):
 from __future__ import annotations
 
 import itertools
+import os
 import threading
 from collections import deque
 from typing import Callable, Deque, List, Optional
@@ -53,6 +54,13 @@ class Request:
     ``submitted`` → ``prefill_start`` → ``first_token`` → ``finished``
     — the per-request span data the observability wiring exports and
     the iteration-level-batching integration test asserts on.
+
+    ``trace_id`` is the request's DISTRIBUTED TRACE IDENTITY (ISSUE 5):
+    unique per process lifetime, stamped on every tracer span/flow
+    event, flight-recorder entry, ``/requestz`` row, and streamed token
+    record this request produces, so one grep correlates a request
+    across the Perfetto timeline, the metrics stream, and a postmortem
+    bundle.
     """
 
     _ids = itertools.count()
@@ -62,6 +70,9 @@ class Request:
                  deadline_t: Optional[float] = None,
                  on_token: Optional[Callable] = None):
         self.id = next(Request._ids)
+        # pid disambiguates across engine restarts on one box; the
+        # counter disambiguates within the process
+        self.trace_id = f"req-{os.getpid():x}-{self.id:08x}"
         self.prompt = prompt
         self.prompt_len = len(prompt)
         self.max_new_tokens = int(max_new_tokens)
@@ -175,3 +186,8 @@ class Scheduler:
     def queue_depth(self) -> int:
         with self._lock:
             return len(self._queue)
+
+    def queued_requests(self) -> List[Request]:
+        """Snapshot of the queue, FIFO order (the /requestz view)."""
+        with self._lock:
+            return list(self._queue)

@@ -382,6 +382,16 @@ def main(argv=None) -> int:
                              "and the cross-rank skew report is appended "
                              "at exit")
     parser.add_argument("--watchdog-timeout", type=float, default=1800.0)
+    parser.add_argument("--statusz-port", type=int, default=None,
+                        help="live introspection HTTP server (/statusz "
+                             "/metricsz /requestz /debugz) on this port; "
+                             "0 picks a free port (printed to stderr)")
+    parser.add_argument("--flight-dump-dir", default=None,
+                        help="crash-bundle directory for the flight "
+                             "recorder (SIGTERM/SIGUSR1/uncaught "
+                             "exception/Watchdog dumps land here; "
+                             "defaults to --out when --statusz-port or "
+                             "an observability sink is active)")
     args = parser.parse_args(argv)
 
     if args.devices:
@@ -405,6 +415,17 @@ def main(argv=None) -> int:
 
     if args.trace_out or args.metrics_out:
         obs.enable()
+    # flight recorder: bounded ring, always teed; crash bundles go to
+    # --flight-dump-dir (explicit) or --out once any sink is active
+    obs.install_tracer_tee()
+    dump_dir = args.flight_dump_dir
+    if dump_dir is None and (args.trace_out or args.metrics_out
+                             or args.statusz_port is not None):
+        dump_dir = args.out
+    if dump_dir:
+        from .global_except_hook import add_hook
+        obs.install_signal_handlers(dump_dir)
+        add_hook()
 
     comm = create_communicator("xla")
     mesh = comm.mesh
@@ -458,6 +479,43 @@ def main(argv=None) -> int:
         trainer.extend(obs.MetricsReport(
             metrics_path, prometheus_path=metrics_path + ".prom",
             monitor=monitor, rank=rank))
+    # goodput attribution for the TRAIN loop: fold the updater's phase
+    # stamps (data → host, compute → compute) + the extension pass
+    # (host) into a ledger surfaced via /statusz and the final result
+    goodput = obs.GoodputLedger()
+
+    class _GoodputFold:
+        trigger = (1, "iteration")
+        priority = 331  # right after MetricsReport's 330 slot
+
+        def observe(self, tr) -> None:
+            phases = getattr(tr.updater, "phase_times", None) or {}
+            goodput.add("host", phases.get("data", 0.0))
+            goodput.add("compute", phases.get("compute", 0.0))
+            ext = getattr(tr, "last_extension_time", None)
+            if ext:
+                goodput.add("host", ext)
+
+        def __call__(self, tr) -> None:
+            pass
+
+        def state_dict(self):
+            return {}
+
+        def load_state_dict(self, state):
+            pass
+
+    trainer.extend(_GoodputFold(), name="goodput_fold")
+    obs.register_provider("train", lambda: {
+        "iteration": trainer.iteration,
+        "last_phase": trainer.last_phase,
+        "elapsed_time": trainer.elapsed_time,
+        "goodput": goodput.report(),
+    })
+    statusz = None
+    if args.statusz_port is not None:
+        statusz = obs.start_status_server(
+            args.statusz_port, dump_dir=dump_dir, rank=rank)
     log = LogReport(trigger=(args.log_every, "iteration"))
     trainer.extend(log)
     trainer.extend(PrintReport(
@@ -474,7 +532,11 @@ def main(argv=None) -> int:
         "world": world,
         "final_loss": final.get("main/loss"),
         "final_accuracy": final.get("main/accuracy"),
+        "goodput": goodput.report(),
     }
+    if statusz is not None:
+        result["statusz_port"] = statusz.port
+        statusz.stop()
     if args.trace_out:
         obs.export_chrome_trace(args.trace_out, rank=rank)
         result["trace_out"] = (args.trace_out if rank is None

@@ -13,6 +13,7 @@ from __future__ import annotations
 import time
 from typing import Any, Callable, Dict, Optional
 
+from ..observability import flight as _flight
 from ..observability import trace as _trace
 from .triggers import get_trigger
 
@@ -139,6 +140,8 @@ class Trainer:
                     self.observation = self.updater.update()
                     self.last_progress = time.monotonic()
                     self.last_phase = "update"
+                    _flight.note("phase", name="update",
+                                 iteration=self.iteration)
                     t_ext = time.perf_counter()
                     with tracer.span("step/extensions", cat="phase"):
                         for e in sorted(self._extensions.values(),

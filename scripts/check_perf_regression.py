@@ -27,11 +27,18 @@ A metric regresses when it is worse than baseline by more than
 Exit codes: 0 = no regression, 1 = regression(s) found, 2 = inputs
 unusable (unreadable, or no comparable metrics).
 
+**History mode** (``--history``): the single positional argument is a
+``bench_history.jsonl`` trajectory (``bench.py --history-out`` appends
+one ``{n, cmd, rc, t, parsed}`` record per run); the gate compares the
+NEWEST round against the previous one.  Fewer than two usable rounds is
+exit 2 (nothing to gate), same as unusable inputs.
+
 Usage::
 
     python scripts/check_perf_regression.py baseline.json current.json
     python scripts/check_perf_regression.py base_metrics.jsonl \
         new_metrics.jsonl --threshold 0.1 --json
+    python scripts/check_perf_regression.py --history bench_history.jsonl
 """
 
 from __future__ import annotations
@@ -181,12 +188,55 @@ def compare(base: Dict[str, float], cur: Dict[str, float],
     return regressions, improvements, unchanged
 
 
+def load_history(path: str) -> Tuple[Dict[str, float], Dict[str, float],
+                                     int, int]:
+    """Newest vs previous round of a bench trajectory: returns
+    ``(base_metrics, cur_metrics, base_n, cur_n)``.  Records must carry
+    an int ``n`` and a dict ``parsed``; non-record lines are skipped
+    (same tolerance as the stream reader)."""
+    rounds: Dict[int, Dict[str, float]] = {}
+    try:
+        with open(path) as f:
+            lines = f.read().splitlines()
+    except OSError as e:
+        print(f"check_perf_regression: cannot read history {path!r}: {e} "
+              f"(exit 2)", file=sys.stderr)
+        raise SystemExit(2)
+    for line in lines:
+        if not line.strip():
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue  # torn tail from a killed bench run
+        if not (isinstance(rec, dict) and isinstance(rec.get("n"), int)
+                and isinstance(rec.get("parsed"), dict)):
+            continue
+        flat: Dict[str, float] = {}
+        _flatten(rec["parsed"], "", flat)
+        if flat:
+            rounds[rec["n"]] = flat  # same n twice: latest wins
+    if len(rounds) < 2:
+        print(f"check_perf_regression: history {path!r} holds "
+              f"{len(rounds)} usable round(s); need 2 to gate (exit 2)",
+              file=sys.stderr)
+        raise SystemExit(2)
+    ns = sorted(rounds)
+    return rounds[ns[-2]], rounds[ns[-1]], ns[-2], ns[-1]
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         description="diff two metrics/bench JSON files; exit 1 on "
                     "regression")
-    parser.add_argument("baseline")
-    parser.add_argument("current")
+    parser.add_argument("baseline",
+                        help="baseline file, or the history JSONL when "
+                             "--history is set")
+    parser.add_argument("current", nargs="?", default=None)
+    parser.add_argument("--history", action="store_true",
+                        help="treat the single positional argument as a "
+                             "bench_history.jsonl trajectory and gate the "
+                             "newest round against the previous one")
     parser.add_argument("--threshold", type=float, default=0.05,
                         help="relative worsening that counts as a "
                              "regression (default 0.05 = 5%%)")
@@ -198,8 +248,19 @@ def main(argv=None) -> int:
                              "stdout (for CI parsing)")
     args = parser.parse_args(argv)
 
-    base = load_metrics(args.baseline)
-    cur = load_metrics(args.current)
+    if args.history:
+        if args.current is not None:
+            parser.error("--history takes ONE positional argument "
+                         "(the trajectory file)")
+        base, cur, base_n, cur_n = load_history(args.baseline)
+        print(f"check_perf_regression: gating history round {cur_n} "
+              f"against round {base_n}", file=sys.stderr)
+    else:
+        if args.current is None:
+            parser.error("two positional arguments required "
+                         "(baseline current) unless --history")
+        base = load_metrics(args.baseline)
+        cur = load_metrics(args.current)
     keys = set(args.keys.split(",")) if args.keys else None
     regressions, improvements, unchanged = compare(
         base, cur, args.threshold, keys)

@@ -1,0 +1,214 @@
+#!/usr/bin/env python
+"""Render a flight-recorder debug bundle into a human postmortem.
+
+A bundle (``chainermn_tpu.observability.flight.dump_bundle``) is raw
+evidence — ring JSONL, health snapshot, trace tail, provider state.
+This script is the first responder's view: WHY did it die, WHAT was it
+doing (the last completed phase, per rank when given several rank
+shards of one gang), was a STRAGGLER involved, and what the SLO /
+goodput state looked like at death.
+
+Usage::
+
+    python scripts/explain_bundle.py result/bundle-20260803-...-sigterm
+    python scripts/explain_bundle.py result/            # newest bundle
+    python scripts/explain_bundle.py result/ --all      # whole gang
+    python scripts/explain_bundle.py <bundle> --json    # machine shape
+
+No JAX import; runs on any box that can read JSON (same contract as
+check_perf_regression.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from chainermn_tpu.observability.flight import (  # noqa: E402
+    find_bundles, read_bundle)
+
+
+def last_phase_of(bundle: dict):
+    """Most reliable "last completed phase" available: the ring's last
+    ``phase`` event, falling back to the health snapshot's trainer
+    stamp."""
+    for ev in reversed(bundle.get("flight", [])):
+        if ev.get("kind") == "phase":
+            return ev.get("name"), ev
+    health = bundle.get("health") or {}
+    if health.get("last_phase"):
+        return health["last_phase"], None
+    wd = (bundle.get("manifest") or {}).get("extra") or {}
+    if wd.get("last_phase"):
+        return wd["last_phase"], None
+    return None, None
+
+
+def straggler_verdict(bundle: dict):
+    """Anomaly/straggler evidence from the ring + health snapshot."""
+    trips = [ev for ev in bundle.get("flight", [])
+             if ev.get("kind") in ("anomaly", "slo_burn")]
+    health = bundle.get("health") or {}
+    counts = ((health.get("anomalies") or {}).get("counts")
+              if isinstance(health.get("anomalies"), dict) else None)
+    if not trips and not counts:
+        return {"verdict": "clean",
+                "detail": "no anomaly or SLO findings on record"}
+    kinds = {}
+    for ev in trips:
+        k = ev.get("kind") if ev.get("kind") != "anomaly" \
+            else ev.get("metric", "anomaly")
+        kinds[k] = kinds.get(k, 0) + 1
+    slow = [ev for ev in trips
+            if "step_time" in str(ev.get("metric", ""))
+            or ev.get("kind") == "slo_burn"]
+    verdict = "degraded before death" if slow else "anomalous"
+    return {"verdict": verdict, "finding_counts": kinds or counts,
+            "last_finding": trips[-1] if trips else None}
+
+
+def explain(bundle: dict) -> dict:
+    man = bundle.get("manifest") or {}
+    env = bundle.get("env") or {}
+    health = bundle.get("health") or {}
+    providers = bundle.get("providers") or {}
+    phase, phase_ev = last_phase_of(bundle)
+    out = {
+        "bundle": bundle.get("path"),
+        "reason": man.get("reason"),
+        "utc": man.get("utc"),
+        "pid": man.get("pid"),
+        "rank": man.get("rank"),
+        "last_completed_phase": phase,
+        "last_phase_detail": phase_ev,
+        "straggler": straggler_verdict(bundle),
+        "ring": {"events": man.get("ring_events"),
+                 "dropped_from_head": man.get("ring_dropped_from_head")},
+        "iteration": health.get("iteration"),
+        "devices": env.get("devices"),
+        "jit_cache_size": env.get("jit_cache_size"),
+    }
+    # last few ring events: the literal final moments
+    tail = bundle.get("flight", [])[-8:]
+    out["final_events"] = [
+        {k: v for k, v in ev.items() if k not in ("args",)}
+        for ev in tail]
+    serving = providers.get("serving")
+    if isinstance(serving, dict):
+        out["serving"] = {
+            k: serving.get(k)
+            for k in ("queue_depth", "busy_slots", "ticks",
+                      "tokens_emitted", "rejected", "prefill_compiles")}
+        if isinstance(serving.get("goodput"), dict):
+            out["goodput"] = {
+                "goodput_frac": serving["goodput"].get("goodput_frac"),
+                "buckets_frac": serving["goodput"].get("buckets_frac")}
+        if isinstance(serving.get("slo"), dict):
+            out["slo_at_death"] = {
+                "pages": serving["slo"].get("pages"),
+                "last_finding": serving["slo"].get("last_finding"),
+                "ttft": serving["slo"].get("ttft")}
+        reqs = serving.get("requests") or {}
+        out["requests_at_death"] = {
+            "queued": len(reqs.get("queued", [])),
+            "running": len(reqs.get("running", [])),
+            "recent": len(reqs.get("recent", []))}
+    train = providers.get("train")
+    if isinstance(train, dict):
+        out["train"] = {k: train.get(k)
+                        for k in ("iteration", "last_phase")}
+        if isinstance(train.get("goodput"), dict):
+            out["goodput"] = {
+                "goodput_frac": train["goodput"].get("goodput_frac"),
+                "buckets_frac": train["goodput"].get("buckets_frac")}
+    return out
+
+
+def render_text(rep: dict) -> str:
+    lines = [
+        f"POSTMORTEM  {rep['bundle']}",
+        f"  died:        {rep['reason']}  (utc {rep['utc']}, "
+        f"pid {rep['pid']}"
+        + (f", rank {rep['rank']}" if rep.get("rank") is not None else "")
+        + ")",
+        f"  last completed phase: {rep['last_completed_phase']}",
+    ]
+    if rep.get("iteration") is not None:
+        lines.append(f"  iteration:   {rep['iteration']}")
+    st = rep.get("straggler") or {}
+    lines.append(f"  straggler verdict: {st.get('verdict')}"
+                 + (f" — {st['finding_counts']}"
+                    if st.get("finding_counts") else ""))
+    if rep.get("goodput"):
+        g = rep["goodput"]
+        lines.append(f"  goodput at death: {g.get('goodput_frac')} "
+                     f"(buckets {g.get('buckets_frac')})")
+    if rep.get("slo_at_death"):
+        lines.append(f"  SLO at death: {json.dumps(rep['slo_at_death'])}")
+    if rep.get("serving"):
+        lines.append(f"  serving: {json.dumps(rep['serving'])}")
+        lines.append(f"  requests at death: "
+                     f"{json.dumps(rep['requests_at_death'])}")
+    if rep.get("final_events"):
+        lines.append("  final ring events:")
+        for ev in rep["final_events"]:
+            lines.append(f"    {json.dumps(ev, sort_keys=True)}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="render a chainermn_tpu debug bundle into a "
+                    "postmortem")
+    parser.add_argument("path",
+                        help="a bundle directory, or a directory holding "
+                             "bundles (the newest is used)")
+    parser.add_argument("--all", action="store_true",
+                        help="when PATH holds several bundles (one per "
+                             "rank of a gang), render every one")
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable output")
+    args = parser.parse_args(argv)
+
+    if os.path.exists(os.path.join(args.path, "MANIFEST.json")):
+        paths = [args.path]
+    else:
+        found = find_bundles(args.path)
+        if not found:
+            print(f"explain_bundle: no bundles under {args.path!r}",
+                  file=sys.stderr)
+            return 2
+        paths = found if args.all else [found[-1]]
+
+    reports = []
+    for p in paths:
+        try:
+            reports.append(explain(read_bundle(p)))
+        except (FileNotFoundError, ValueError, OSError) as e:
+            # a torn bundle (killed mid-dump) must not take down the
+            # postmortem of its intact siblings
+            print(f"explain_bundle: skipping {p!r}: {e}", file=sys.stderr)
+    if not reports:
+        print("explain_bundle: no readable bundles", file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps(reports if args.all else reports[0], indent=2,
+                         sort_keys=True, default=str))
+    else:
+        for rep in reports:
+            print(render_text(rep))
+            print()
+        if len(reports) > 1:
+            # gang view: name the rank whose last phase lags the others
+            phases = {r.get("rank"): r.get("last_completed_phase")
+                      for r in reports}
+            print(f"gang: last completed phase per rank: {phases}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

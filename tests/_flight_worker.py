@@ -1,0 +1,97 @@
+"""Subprocess worker for the flight-recorder death tests.
+
+Stands up a REAL (tiny) serving run — random-init TP transformer LM,
+2-slot pool, a few requests decoded to completion — so the bundle a
+death produces carries genuine serving/trace/comm state, then dies the
+way the mode says:
+
+* ``sigterm``  — prints READY and idles; the parent delivers SIGTERM
+  and the installed signal handler dumps a bundle before the default
+  disposition kills the process.
+* ``watchdog`` — arms a Watchdog (tiny timeout) fed by a stub trainer,
+  heartbeats once, then wedges; the watchdog dumps evidence (incl. the
+  bundle) and aborts with os._exit(43).
+* ``crash``    — raises an uncaught exception; the global except hook
+  dumps the bundle.
+* ``statusz``  — starts the introspection server on a free port, prints
+  ``STATUSZ_PORT=<n>`` and READY, then serves until SIGTERM (the
+  slow-tier live-endpoint test drives the HTTP surface from outside).
+
+Usage: python tests/_flight_worker.py <mode> <dump_dir>
+"""
+
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# run as a script path: the repo root is the parent of this file's dir
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main() -> int:
+    mode, dump_dir = sys.argv[1], sys.argv[2]
+    os.makedirs(dump_dir, exist_ok=True)
+
+    import jax
+    import numpy as np
+
+    import chainermn_tpu as mn
+    from chainermn_tpu import global_except_hook
+    from chainermn_tpu import observability as obs
+    from chainermn_tpu.parallel import init_tp_transformer_lm
+    from chainermn_tpu.serving import ServingEngine
+
+    obs.enable()
+    obs.install_tracer_tee()
+    obs.install_signal_handlers(dump_dir)
+    global_except_hook.add_hook()
+
+    params = init_tp_transformer_lm(
+        jax.random.PRNGKey(0), 16, 8, 2, 1, max_len=32)
+    mesh = mn.make_nd_mesh(("model",), (1,), jax.devices()[:1])
+    eng = ServingEngine(params, head_dim=4, n_slots=2, max_total=16,
+                        mesh=mesh, queue_capacity=8)
+    rng = np.random.RandomState(0)
+    for _ in range(3):
+        eng.submit(rng.randint(0, 16, 4).astype(np.int32), 4)
+    eng.run(steps_budget=50)
+
+    statusz = None
+    if mode == "statusz":
+        statusz = obs.start_status_server(
+            0, extra_gauges=eng.metrics, requests_fn=eng.requests_table,
+            dump_dir=dump_dir)
+        print(f"STATUSZ_PORT={statusz.port}", flush=True)
+
+    if mode == "crash":
+        print("READY", flush=True)
+        raise RuntimeError("injected uncaught exception (flight test)")
+
+    if mode == "watchdog":
+        from chainermn_tpu.extensions.watchdog import Watchdog
+
+        class _StubTrainer:
+            # the attribute surface Watchdog + health_snapshot read
+            out = dump_dir
+            iteration = 7
+            last_phase = "serving/step"
+            elapsed_time = 0.0
+            last_progress = None
+            observation = {}
+
+        wd = Watchdog(timeout=1.0, dump_dir=dump_dir, poll_interval=0.1)
+        t = _StubTrainer()
+        wd.initialize(t)
+        wd.observe(t)           # arm the heartbeat...
+        print("READY", flush=True)
+        time.sleep(300)         # ...then wedge: the watchdog aborts us
+        return 1                # unreachable
+
+    print("READY", flush=True)
+    while True:                 # sigterm / statusz: idle until killed
+        time.sleep(0.1)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

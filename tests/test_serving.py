@@ -424,6 +424,48 @@ def test_serve_cli_inprocess(tmp_path, capsys):
     kinds = [r["kind"] for r in records]
     assert "serving_step" in kinds and kinds[-1] == "serving_summary"
     assert records[-1]["serving/tokens_per_sec"] > 0
+    # ISSUE 5 acceptance: the goodput ledger PARTITIONS wall time — the
+    # bucket sums reconcile against the wall clock within 5%
+    g = summary["goodput"]
+    assert g["coverage_frac"] >= 0.95, g
+    # report fields are independently rounded to 6 decimals: tolerance
+    # is one ulp-of-rounding per bucket
+    assert abs(sum(g["buckets_s"].values()) - g["attributed_s"]) < 1e-5
+    assert g["buckets_s"]["compile"] > 0  # first prefill+tick compiles
+
+
+def test_latency_stats_bounded_by_reservoir(devices):
+    """Satellite (ISSUE 5): the engine's latency stats must be O(1)
+    memory — submit MORE requests than ``stats_capacity`` and the
+    reservoirs stay at capacity while total_seen counts every sample and
+    the percentiles stay plausible."""
+    from chainermn_tpu.serving import ServingEngine
+
+    params = _params()
+    mesh = _mesh(devices, 1)
+    cap = 4
+    eng = ServingEngine(params, head_dim=HEAD_DIM, n_slots=2, max_total=16,
+                        mesh=mesh, queue_capacity=16,
+                        max_prefills_per_tick=2, stats_capacity=cap)
+    rng = np.random.RandomState(3)
+    handles = [eng.submit(rng.randint(0, VOCAB, 4).astype(np.int32), 3)
+               for _ in range(cap * 2)]          # 8 > capacity 4
+    eng.run(steps_budget=200)
+    for h in handles:
+        assert h.status == "done", (h.id, h.status)
+    assert len(eng._ttft_ms) <= cap
+    assert eng._ttft_ms.total_seen == cap * 2     # every TTFT observed
+    assert len(eng._tok_lat_ms) <= cap
+    assert eng._tok_lat_ms.total_seen > cap       # many ticks sampled
+    m = eng.metrics()
+    assert m["serving/ttft_p50_ms"] > 0
+    assert m["serving/ttft_p99_ms"] >= m["serving/ttft_p50_ms"]
+    # close() retires the flight/statusz provider registration so a
+    # dead engine is neither pinned in memory nor reported as live
+    from chainermn_tpu.observability import flight
+    assert flight._PROVIDERS.get("serving") is not None
+    eng.close()
+    assert "serving" not in flight._PROVIDERS
 
 
 @pytest.mark.slow

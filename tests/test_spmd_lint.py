@@ -308,6 +308,11 @@ class TestSelfRun:
         assert by_name["serving.prefill_family"].n_compiles == 2
         # collective sequences were extracted, not vacuously empty
         assert by_name["ops.collective.ring"].collectives
+        # ISSUE 5 wiring: tracing + flight tee leave the tick at ONE
+        # program, and the teed collective ring still traces its
+        # collectives (the tee is host-only bookkeeping)
+        assert by_name["serving.tick_with_tracing"].n_compiles == 1
+        assert by_name["observability.flight_ring"].collectives
 
 
 class TestCLI:
