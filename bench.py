@@ -111,6 +111,56 @@ def build_step(arch, image_size, per_chip_batch, allreduce_grad_dtype=None,
     return step, variables, opt_state, batch, n_chips, global_batch
 
 
+def comm_bytes_model(step_fn, *step_args):
+    """Predicted vs ledgered wire bytes for one step program (ISSUE 6).
+
+    ``measured_comm_bytes`` — the PR 1 comm-ledger rows booked while
+    TRACING the step under the accounting layer: in-jit bookings land at
+    trace time and are replayed per execution, so this is exactly the
+    per-step ledger a traced run reports.  MUST run before any other
+    lower/compile of the same function: a pjit cache hit books nothing
+    (probed; the shard-flow reconciliation relies on the same fact).
+
+    ``predicted_comm_bytes`` — the shard-flow static cost model over the
+    identical jaxpr (ledger convention: payload bytes per collective
+    call).  On legacy jax the AD-inserted gradient psum is ledger-only
+    (``comm.note``), so its noted rows are added to the prediction to
+    keep the two series tracking together (docs/ANALYSIS.md).
+
+    Both series land in every BENCH section and in bench_history.jsonl,
+    so ``check_perf_regression.py --history`` gates wire-byte drift —
+    "bytes" keys compare lower-is-better — not just time.
+    """
+    import jax
+
+    from chainermn_tpu import observability as obs
+    from chainermn_tpu._compat import ad_inserts_replicated_psum
+    from chainermn_tpu.analysis import shardflow
+    from chainermn_tpu.observability.comm import get_accountant
+
+    was = obs.enabled()
+    obs.enable()
+    acct = get_accountant()
+    try:
+        with acct.step("bench_comm_model"):
+            jaxpr = jax.make_jaxpr(step_fn)(*step_args)
+        rows = dict((acct.last_step_report or {}).get("per_op", {}))
+    finally:
+        if not was:
+            obs.disable()
+    measured = sum(int(r["bytes"]) for r in rows.values())
+    predicted = sum(shardflow.group_bytes(
+        shardflow.static_costs(jaxpr)).values())
+    if not ad_inserts_replicated_psum():
+        predicted += sum(int(r.get("noted_bytes", 0))
+                         for r in rows.values())
+    return {
+        "predicted_comm_bytes": int(predicted),
+        "measured_comm_bytes": int(measured),
+        "per_op": {k: {"bytes": int(v["bytes"])} for k, v in rows.items()},
+    }
+
+
 def compile_with_flops(step, variables, opt_state, batch):
     """AOT-compile the step once; return (callable, flops, bytes_accessed)
     — the same executable is then timed, so the compile cost is paid
@@ -624,13 +674,42 @@ def bench_serving():
             "steps": steps,  # bookkeeping; the gate's _SKIP drops it
         }
 
-    return {
+    def tick_comm_model():
+        """Predicted vs ledgered wire bytes of ONE decode tick at the
+        bench config.  The engine's live tick is already compiled (a
+        cache-hit trace books nothing), so trace a FRESH build of the
+        IDENTICAL program (`_build_tick` closes over the same params/
+        specs/mesh) against the warmed pool state."""
+        import jax.numpy as jnp
+
+        from chainermn_tpu.serving import ServingEngine as _SE
+
+        eng = _SE(params, head_dim=d_model // n_heads, n_slots=n_slots,
+                  max_total=s_p + new, mesh=mesh,
+                  queue_capacity=n_requests)
+        h = eng.submit(prompts[0], 2)
+        eng.run(steps_budget=4)
+        assert h.status == "done", h.status
+        de = eng.engine
+        tokens = jnp.zeros((n_slots,), jnp.int32)
+        pos = jnp.asarray(np.array(eng.pool.pos, np.int32, copy=True))
+        cm = comm_bytes_model(de._build_tick(), de._params,
+                              eng.pool.caches, tokens, pos)
+        cm.pop("per_op", None)  # the tick's 2 ops don't warrant rows
+        return cm
+
+    out = {
         "config": f"d{d_model} L{n_layers} h{n_heads} V{vocab} "
                   f"slots{n_slots} prompt{s_p} new{new} "
                   f"x{n_requests} requests",
         "load_high": run_point(1),
         "load_low": run_point(4),
     }
+    try:
+        out["comm_per_tick"] = tick_comm_model()
+    except Exception as e:
+        print(f"bench: serving comm model failed: {e!r}", file=sys.stderr)
+    return out
 
 
 def scaling_worker(n, grad_dtype=None, double_buffering=False):
@@ -656,6 +735,16 @@ def scaling_worker(n, grad_dtype=None, double_buffering=False):
         "resnet18", 32, 4, allreduce_grad_dtype=grad_dtype,
         double_buffering=double_buffering)
     assert n_chips == n, (n_chips, n)
+    # wire-byte model per scaling point — BEFORE measure() compiles the
+    # step (trace-time bookings; see comm_bytes_model).  The compressed
+    # points' whole purpose is fewer wire bytes: with these two fields
+    # in every point, the history gate catches a quantization/compression
+    # change that silently regresses bytes while time stays flat.
+    cm = None
+    try:
+        cm = comm_bytes_model(step, variables, opt_state, batch)
+    except Exception as e:
+        print(f"bench: scaling comm model failed: {e!r}", file=sys.stderr)
     steps = 3 if n <= 4 else 2
     # median-of-3: a single-sample point on a time-shared host published a
     # 116.9% efficiency in BENCH_r04.json — noise, but it reads as a claim.
@@ -663,6 +752,9 @@ def scaling_worker(n, grad_dtype=None, double_buffering=False):
                     epochs=3, reduce="median")
     out = {"n": n, "total_ips": steps * global_batch / dt,
            "step_ms": dt / steps * 1e3}
+    if cm is not None:
+        out["predicted_comm_bytes"] = cm["predicted_comm_bytes"]
+        out["measured_comm_bytes"] = cm["measured_comm_bytes"]
 
     # gradient-sized pmean in isolation (same dtype as the wire)
     if n > 1:
@@ -951,6 +1043,13 @@ def main():
     grad_bytes = int(sum(
         _np.prod(l.shape) for l in
         jax.tree_util.tree_leaves(variables["params"])) * 4)
+    # predicted vs ledgered wire bytes — BEFORE the AOT lower (a cache-
+    # hit trace books nothing); one extra host-side trace, no execution
+    comm_model = None
+    try:
+        comm_model = comm_bytes_model(step, variables, opt_state, batch)
+    except Exception as e:
+        print(f"bench: comm model failed: {e!r}", file=sys.stderr)
     step, flops_per_step, bytes_per_step = compile_with_flops(
         step, variables, opt_state, batch)
     dt, _ = measure(step, variables, opt_state, batch, steps)
@@ -1091,6 +1190,7 @@ def main():
         "flops_per_image": round(flops_per_image, 1) if flops_per_image else None,
         "flops_source": flops_source if flops_per_image else None,
         "allreduce_grad_dtype": args.allreduce_grad_dtype,
+        "comm": comm_model,
         "batch_sweep": batch_sweep,
         "nf_resnet50": None,
         "transformer_lm": None,

@@ -78,6 +78,22 @@ def pcast_varying(x, axis_names):
     return x
 
 
+def ad_inserts_replicated_psum() -> bool:
+    """Whether autodiff of a shard_map with REPLICATED params inserts the
+    cross-rank cotangent psum into the traced program.
+
+    True on vma-tracking jax (native ``pcast``/``pvary``): replicated
+    inputs carry a type-level broadcast whose transpose is a psum, so the
+    gradient all-reduce is a visible jaxpr equation.  False on 0.4.x
+    (``check_rep=False`` legacy shard_map): cotangents of replicated
+    inputs stay per-rank local and NO psum equation exists — which is why
+    ``train.py`` books that traffic via ``observability.comm.note`` and
+    why the shard-flow reconciliation (analysis/shardflow.py) gates the
+    noted row's expected equation on this probe.
+    """
+    return _NATIVE_PCAST is not None or _NATIVE_PVARY is not None
+
+
 try:
     import inspect
     _SDS_HAS_VMA = "vma" in inspect.signature(

@@ -19,6 +19,18 @@ Two complementary engines:
   (unbound-axis) and for recompilation hazards (recompile-hazard, with an
   explicit allowlist for the per-prompt-length prefill programs).
 
+A third pass builds on the jaxpr engine: the **shard-flow analyzer**
+(``shardflow``) propagates sharding through every registered entry point
+to produce a replication report (what is fully materialized per replica
+— the ZeRO-1 target), a static collective cost model (wire bytes +
+message counts), and a peak-live-memory-per-replica estimate — and
+RECONCILES the static predictions against the runtime comm ledger by
+executing each entry point under the PR 1 accounting layer (exact byte
+equality; the cost model can never silently rot).  Runner:
+``scripts/shardflow_report.py`` / ``python -m
+chainermn_tpu.analysis.shardflow``; baseline:
+``.shardflow-baseline.json``.
+
 The collective surface is *derived*, not hardcoded: ``registry.py`` parses
 ``ops/collective.py`` and ``communicators/base.py`` so new collectives are
 linted the day they land.
@@ -46,6 +58,10 @@ from .ast_engine import (  # noqa: F401
     analyze_paths,
     analyze_source,
 )
+from .shardflow import (  # noqa: F401  (stdlib-only at import time)
+    SHARDFLOW_RULES,
+    ShardflowReport,
+)
 
 __all__ = [
     "AST_RULES",
@@ -53,6 +69,8 @@ __all__ = [
     "CollectiveRegistry",
     "Finding",
     "SEVERITIES",
+    "SHARDFLOW_RULES",
+    "ShardflowReport",
     "analyze_file",
     "analyze_paths",
     "analyze_source",

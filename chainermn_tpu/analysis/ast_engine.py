@@ -23,6 +23,13 @@ flow                        a jitted function — TracerBoolConversionError at
                             best, silent trace-time specialization at worst
 inplace-jit-      warning   in-place mutation of a name that is also passed to
 mutation                    a jitted callable in the same scope
+mismatched-       error     shard_map whose body reduces over a literal axis
+shard-specs                 the same-scope mesh doesn't bind, or whose
+                            out_specs shard an axis the returned collective
+                            just reduced over
+donated-buffer-   warning   a name passed at a ``donate_argnums`` position of
+reuse                       a jitted call and READ afterwards — the buffer may
+                            alias the output (the serving cache-pool hazard)
 ================  ========  ====================================================
 
 The linear-flow rules (key reuse, deadlock-after-return) process loop
@@ -54,6 +61,11 @@ AST_RULES: Dict[str, Tuple[str, str]] = {
         "error", "Python branch on a traced value inside jit"),
     "inplace-jit-mutation": (
         "warning", "in-place mutation of an argument of a jitted call"),
+    "mismatched-shard-specs": (
+        "error", "shard_map specs inconsistent with the axis the body "
+                 "reduces over"),
+    "donated-buffer-reuse": (
+        "warning", "donate_argnums'd buffer read after the jitted call"),
 }
 
 _PRNG_CONSUMERS = frozenset({
@@ -74,6 +86,17 @@ def _name_of(expr: ast.AST) -> Optional[str]:
         return expr.id
     if isinstance(expr, ast.Attribute):
         return expr.attr
+    return None
+
+
+def _dotted_name(expr: ast.AST) -> Optional[str]:
+    """Full dotted path of a Name/Attribute chain (``pool.caches``), or
+    None when the base is computed (``f().x``, ``a[i].x``)."""
+    if isinstance(expr, ast.Name):
+        return expr.id
+    if isinstance(expr, ast.Attribute):
+        base = _dotted_name(expr.value)
+        return f"{base}.{expr.attr}" if base is not None else None
     return None
 
 
@@ -105,6 +128,21 @@ def _terminates(stmts: Sequence[ast.stmt]) -> bool:
                for s in stmts)
 
 
+def _donate_positions(call: ast.Call) -> Tuple[int, ...]:
+    """Literal ``donate_argnums`` positions of a jit call, or ()."""
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            try:
+                v = ast.literal_eval(kw.value)
+            except ValueError:
+                continue
+            if isinstance(v, int):
+                return (v,)
+            if isinstance(v, (tuple, list)):
+                return tuple(int(i) for i in v if isinstance(i, int))
+    return ()
+
+
 @dataclass
 class _Ctx:
     """Module-wide facts collected in one pre-pass."""
@@ -112,12 +150,14 @@ class _Ctx:
     jitted_value_names: Set[str]   # x = jax.jit(f) / partial(jax.jit, ...)
     jitted_def_names: Set[str]     # defs decorated with / passed to jit
     static_params: Dict[str, Set[str]]  # def name -> static_argnames
+    donated_callables: Dict[str, Tuple[int, ...]]  # name -> donate_argnums
 
 
 def _collect_ctx(tree: ast.Module, registry: CollectiveRegistry) -> _Ctx:
     jitted_values: Set[str] = set()
     jitted_defs: Set[str] = set()
     static_params: Dict[str, Set[str]] = {}
+    donated: Dict[str, Tuple[int, ...]] = {}
 
     def static_names_from_call(call: ast.Call) -> Set[str]:
         out: Set[str] = set()
@@ -139,15 +179,25 @@ def _collect_ctx(tree: ast.Module, registry: CollectiveRegistry) -> _Ctx:
                     jitted = True
                     if isinstance(dec, ast.Call):
                         statics |= static_names_from_call(dec)
+                        pos = _donate_positions(dec)
+                        if pos:
+                            donated[node.name] = pos
             if jitted:
                 jitted_defs.add(node.name)
                 static_params[node.name] = statics
         elif isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
             # x = jax.jit(f)   |   x = partial(jax.jit, ...)(f)
             if _is_jit_expr(node.value.func) or _is_jit_expr(node.value):
+                pos = _donate_positions(node.value)
+                if not pos and isinstance(node.value.func, ast.Call):
+                    # partial(jax.jit, donate_argnums=...)(f): the
+                    # kwarg lives on the INNER partial call
+                    pos = _donate_positions(node.value.func)
                 for t in node.targets:
                     if isinstance(t, ast.Name):
                         jitted_values.add(t.id)
+                        if pos:
+                            donated[t.id] = pos
         if isinstance(node, ast.Call) and (
                 _is_jit_expr(node.func) or _is_shard_map_expr(node.func)):
             # jax.jit(step) / shard_map(body, mesh=...) — the named def
@@ -160,7 +210,8 @@ def _collect_ctx(tree: ast.Module, registry: CollectiveRegistry) -> _Ctx:
                     static_params.setdefault(nm, set()).update(statics)
 
     return _Ctx(registry=registry, jitted_value_names=jitted_values,
-                jitted_def_names=jitted_defs, static_params=static_params)
+                jitted_def_names=jitted_defs, static_params=static_params,
+                donated_callables=donated)
 
 
 # --------------------------------------------------------------------------
@@ -189,6 +240,11 @@ class _Scope:
         self.local_jitted: Set[str] = set(ctx.jitted_value_names)
         self.mutations: List[Tuple[str, int]] = []  # (name, line)
         self._emitted: Set[Tuple[str, int]] = set()
+        # donate_argnums tracking: callable name -> donated positions,
+        # live buffer name -> line of the donating call
+        self.donating: Dict[str, Tuple[int, ...]] = dict(
+            ctx.donated_callables)
+        self.donated_bufs: Dict[str, int] = {}
 
     # ---- helpers ----
     def emit(self, rule: str, line: int, message: str) -> None:
@@ -247,6 +303,30 @@ class _Scope:
         if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
                            ast.ClassDef)):
             return  # nested defs are their own scopes (and don't run here)
+
+        # -- rule: donated-buffer-reuse (reads BEFORE this statement's
+        # own donations register, so the donating call's own args and a
+        # rebinding `x = step(x, ...)` stay clean; names are full dotted
+        # paths, so `pool.caches` — the serving cache-pool shape — is
+        # tracked like a local) --
+        if self.donated_bufs:
+            for n in self._iter_own_exprs(st):
+                if not (isinstance(n, (ast.Name, ast.Attribute))
+                        and isinstance(n.ctx, ast.Load)):
+                    continue
+                dn = _dotted_name(n)
+                if dn is None or dn not in self.donated_bufs:
+                    continue
+                don_line = self.donated_bufs.pop(dn)
+                self.emit(
+                    "donated-buffer-reuse", n.lineno,
+                    f"`{dn}` was DONATED to the jitted call on line "
+                    f"{don_line} (donate_argnums) and is read again — "
+                    "the buffer may already be aliased to the output "
+                    "(garbage on TPU, use-after-free semantics); "
+                    "rebind the result (`x = step(x, ...)`) or pass a "
+                    "copy (the serving cache-pool hazard class)")
+
         # -- rule: collective-deadlock (call sites) --
         if guard is not None:
             for call in self._iter_own_exprs(st):
@@ -271,13 +351,29 @@ class _Scope:
         # survive the descent: a collective wrapped in a plain loop/with/
         # try INSIDE a rank-guarded branch is still rank-guarded
         if isinstance(st, ast.If):
-            if self._is_rank_expr(st.test):
-                g = f"under the rank-dependent branch at line {st.lineno}"
-                self._walk_block(st.body, g)
-                self._walk_block(st.orelse, g)
+            g = (f"under the rank-dependent branch at line {st.lineno}"
+                 if self._is_rank_expr(st.test) else guard)
+            # donation state is branch-scoped: a call donating in one
+            # branch must not flag a read in the mutually-exclusive
+            # other branch; after the If, a donation from EITHER branch
+            # stays live (either may have executed) — UNLESS that branch
+            # terminates (return/raise/break/continue), in which case
+            # control past the If can only have come through the other
+            # path and the terminated branch's donations are unreachable
+            snap = dict(self.donated_bufs)
+            self._walk_block(st.body, g)
+            after_body = self.donated_bufs
+            self.donated_bufs = dict(snap)
+            self._walk_block(st.orelse, g)
+            after_else = self.donated_bufs
+            if _terminates(st.body):
+                merged = after_else
+            elif _terminates(st.orelse):
+                merged = after_body
             else:
-                self._walk_block(st.body, guard)
-                self._walk_block(st.orelse, guard)
+                merged = dict(after_else)
+                merged.update(after_body)
+            self.donated_bufs = merged
         elif isinstance(st, (ast.For, ast.AsyncFor)):
             g = guard
             if self._is_rank_expr(st.iter):
@@ -409,6 +505,40 @@ class _Scope:
                     if isinstance(a, ast.Name):
                         self.jit_args.add(a.id)
 
+        # donate_argnums bookkeeping — donations from this statement's
+        # calls register FIRST, then assignment targets clear, so the
+        # canonical `params, opt = step(params, opt, b)` rebinding
+        # consumes its own donation within one statement
+        for n in self._iter_own_exprs(st):
+            if isinstance(n, ast.Call):
+                callee = _name_of(n.func)
+                pos = self.donating.get(callee) if callee else None
+                if pos:
+                    for i in pos:
+                        if i >= len(n.args):
+                            continue
+                        dn = _dotted_name(n.args[i])
+                        if dn is not None:
+                            self.donated_bufs[dn] = st.lineno
+        rebind_targets: List[ast.AST] = []
+        if isinstance(st, ast.Assign):
+            rebind_targets = list(st.targets)
+        elif isinstance(st, (ast.AnnAssign, ast.AugAssign)):
+            rebind_targets = [st.target]
+        elif isinstance(st, (ast.For, ast.AsyncFor)):
+            rebind_targets = [st.target]
+        for t in rebind_targets:
+            els = t.elts if isinstance(t, (ast.Tuple, ast.List)) else [t]
+            for el in els:
+                dn = _dotted_name(el)
+                if dn is None:
+                    continue
+                # rebinding clears the path AND everything under it —
+                # `pool = fresh_pool()` revives `pool.caches` too
+                for k in [k for k in self.donated_bufs
+                          if k == dn or k.startswith(dn + ".")]:
+                    self.donated_bufs.pop(k, None)
+
         self._check_mutations()
 
     def _track_assign_value(self, targets, value) -> None:
@@ -523,6 +653,213 @@ def _check_traced_control_flow(ctx: _Ctx, fn_node, qualname: str,
 
 
 # --------------------------------------------------------------------------
+# mismatched-shard-specs (per scope, separate small pass)
+# --------------------------------------------------------------------------
+
+#: ops whose result is reduced/replicated over the named axis — an
+#: out_spec that SHARDS the result over that same axis contradicts the
+#: body (shard_map's replication checker rejects it at run time; this
+#: catches it at lint time, jax-free).
+_REDUCING_OPS = frozenset({
+    "psum", "pmean", "pmax", "pmin", "pmean_if_bound", "all_gather",
+    "bcast", "quantized_ring_pmean", "hierarchical_pmean",
+})
+
+
+def _mesh_literal_axes(call: ast.Call) -> Optional[Set[str]]:
+    """Axis names of a mesh-constructing call, when they are literals:
+    ``make_nd_mesh(("a", "b"), ...)``, ``make_mesh(axis_name="x")``,
+    ``Mesh(devs, ("a",))``.  None = not resolvable (stay silent)."""
+    fname = _name_of(call.func)
+
+    def str_literals(expr) -> Optional[Set[str]]:
+        try:
+            v = ast.literal_eval(expr)
+        except (ValueError, SyntaxError):
+            return None
+        if isinstance(v, str):
+            return {v}
+        if isinstance(v, (tuple, list)) and all(
+                isinstance(x, str) for x in v):
+            return set(v)
+        return None
+
+    if fname == "make_nd_mesh" and call.args:
+        return str_literals(call.args[0])
+    if fname == "make_mesh":
+        for kw in call.keywords:
+            if kw.arg == "axis_name":
+                return str_literals(kw.value)
+        return None
+    if fname == "Mesh":
+        if len(call.args) >= 2:
+            return str_literals(call.args[1])
+        for kw in call.keywords:
+            if kw.arg == "axis_names":
+                return str_literals(kw.value)
+    return None
+
+
+def _pspec_axes(expr: ast.AST) -> Set[str]:
+    """Axis-name string literals inside any ``P(...)``/``PartitionSpec``
+    call of an (in|out)_specs expression."""
+    out: Set[str] = set()
+    for n in ast.walk(expr):
+        if isinstance(n, ast.Call) and _name_of(n.func) in (
+                "P", "PartitionSpec"):
+            for a in n.args:
+                for sub in ast.walk(a):
+                    if isinstance(sub, ast.Constant) and \
+                            isinstance(sub.value, str):
+                        out.add(sub.value)
+    return out
+
+
+def _collective_axis_literals_of_call(call: ast.Call) -> Set[str]:
+    """Literal axis names ONE collective call names: the ``axis_name=``
+    (or hierarchical ``chip_axis=``/``slice_axis=``) keyword, or any
+    positional string literal (``psum(x, "mn")``).  Non-literal axes
+    resolve to nothing — the rule only argues from positive evidence."""
+    axes: Set[str] = set()
+    for kw in call.keywords:
+        if kw.arg in ("axis_name", "chip_axis", "slice_axis") and \
+                isinstance(kw.value, ast.Constant) and \
+                isinstance(kw.value.value, str):
+            axes.add(kw.value.value)
+    for a in call.args:
+        if isinstance(a, ast.Constant) and isinstance(a.value, str):
+            axes.add(a.value)
+    return axes
+
+
+def _collective_axis_literals(fn_node, registry: CollectiveRegistry
+                              ) -> Set[str]:
+    """Union of :func:`_collective_axis_literals_of_call` over every
+    collective call in a body function."""
+    axes: Set[str] = set()
+    for n in ast.walk(fn_node):
+        if isinstance(n, ast.Call) and registry.is_collective_call(n):
+            axes |= _collective_axis_literals_of_call(n)
+    return axes
+
+
+def _check_shard_specs(ctx: _Ctx, scope_node, qualname: str,
+                       findings: List[Finding]) -> None:
+    """Two inconsistency shapes at a ``shard_map(...)`` call site, argued
+    only from same-scope literals (no cross-module resolution — silence
+    over speculation):
+
+    * the body's collectives name a LITERAL axis absent from the mesh
+      whose axis names are resolvable in this scope — the compiled gang
+      would raise (or bind the wrong axis) at run time;
+    * the body RETURNS a reducing collective over axis ``a`` (result
+      replicated over ``a``) while ``out_specs`` shards over ``a`` —
+      shard_map's replication checker rejects exactly this, but only
+      once jax runs.
+    """
+    body = getattr(scope_node, "body", [])
+    local_defs: Dict[str, ast.AST] = {}
+    mesh_axes_of: Dict[str, Set[str]] = {}
+    for st in body:
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            local_defs[st.name] = st
+        if isinstance(st, ast.Assign) and isinstance(st.value, ast.Call):
+            ax = _mesh_literal_axes(st.value)
+            if ax:
+                for t in st.targets:
+                    if isinstance(t, ast.Name):
+                        mesh_axes_of[t.id] = ax
+
+    # shard_map calls belonging to THIS scope (not nested defs)
+    stack: List[ast.AST] = list(body)
+    calls: List[ast.Call] = []
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.ClassDef)):
+            continue
+        if isinstance(n, ast.Call) and _is_shard_map_expr(n.func):
+            calls.append(n)
+        stack.extend(ast.iter_child_nodes(n))
+
+    for call in calls:
+        if not call.args:
+            continue
+        # resolve the body: a same-scope def, possibly through
+        # partial(fn, axis_name="x")
+        first = call.args[0]
+        partial_axes: Set[str] = set()
+        if isinstance(first, ast.Call) and _name_of(first.func) == "partial":
+            for kw in first.keywords:
+                if kw.arg == "axis_name" and \
+                        isinstance(kw.value, ast.Constant) and \
+                        isinstance(kw.value.value, str):
+                    partial_axes.add(kw.value.value)
+            first = first.args[0] if first.args else first
+        body_def = local_defs.get(_name_of(first) or "")
+
+        mesh_axes: Optional[Set[str]] = None
+        out_specs_expr = None
+        for kw in call.keywords:
+            if kw.arg == "mesh":
+                if isinstance(kw.value, ast.Name):
+                    mesh_axes = mesh_axes_of.get(kw.value.id)
+                elif isinstance(kw.value, ast.Call):
+                    mesh_axes = _mesh_literal_axes(kw.value)
+            elif kw.arg == "out_specs":
+                out_specs_expr = kw.value
+
+        body_axes: Set[str] = set(partial_axes)
+        if body_def is not None:
+            body_axes |= _collective_axis_literals(body_def, ctx.registry)
+
+        if mesh_axes and body_axes:
+            stray = sorted(body_axes - mesh_axes)
+            if stray:
+                findings.append(Finding(
+                    rule="mismatched-shard-specs",
+                    severity=AST_RULES["mismatched-shard-specs"][0],
+                    path="", line=call.lineno,
+                    message=(
+                        f"shard_map body reduces over axis "
+                        f"{stray} but the mesh built in this scope only "
+                        f"binds {sorted(mesh_axes)} — the collective "
+                        "would hit an unbound (or wrong) axis at run "
+                        "time; make the body's axis_name and the mesh "
+                        "agree"),
+                    context=qualname))
+
+        if body_def is not None and out_specs_expr is not None:
+            out_axes = _pspec_axes(out_specs_expr)
+            if out_axes:
+                for ret in ast.walk(body_def):
+                    if not (isinstance(ret, ast.Return)
+                            and isinstance(ret.value, ast.Call)):
+                        continue
+                    rcall = ret.value
+                    rname = _name_of(rcall.func)
+                    if rname not in _REDUCING_OPS:
+                        continue
+                    raxes = _collective_axis_literals_of_call(rcall)
+                    clash = sorted(raxes & out_axes)
+                    if clash:
+                        findings.append(Finding(
+                            rule="mismatched-shard-specs",
+                            severity=AST_RULES["mismatched-shard-specs"][0],
+                            path="", line=call.lineno,
+                            message=(
+                                f"the body returns `{rname}` over axis "
+                                f"{clash} — a value REPLICATED over that "
+                                "axis — but out_specs shards the output "
+                                f"over {clash}: each rank would keep only "
+                                "a slice of an identical value (and the "
+                                "replication checker rejects it); use "
+                                "P() for reduced outputs, or drop the "
+                                "reduction"),
+                            context=qualname))
+
+
+# --------------------------------------------------------------------------
 # entry points
 # --------------------------------------------------------------------------
 
@@ -564,6 +901,7 @@ def analyze_source(source: str, path: str,
             _check_traced_control_flow(ctx, node, qualname, findings)
         else:
             _Scope(ctx, node, qualname, findings).run()
+        _check_shard_specs(ctx, node, qualname, findings)
 
     lines = source.splitlines()
     sup = Suppressions(source)

@@ -65,6 +65,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--no-jaxpr", action="store_true",
                    help="skip the jaxpr engine (no jax import: pure-AST "
                         "mode, runs on any box)")
+    p.add_argument("--entry", action="append", default=None,
+                   metavar="NAME",
+                   help="run the jaxpr checks on ONE registered entry "
+                        "point (repeatable; default: all) — iterate on "
+                        "a single subsystem without paying the whole "
+                        "sweep")
     return p
 
 
@@ -95,6 +101,10 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f"error: unknown rule(s): {', '.join(sorted(unknown))} "
                   "(see --list-rules)", file=sys.stderr)
             return 2
+    if args.entry and args.no_jaxpr:
+        print("error: --entry needs the jaxpr engine (drop --no-jaxpr)",
+              file=sys.stderr)
+        return 2
 
     registry = default_registry()
     findings = analyze_paths(paths, registry=registry, rules=rules)
@@ -103,7 +113,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     if not args.no_jaxpr:
         try:
             from .jaxpr_engine import check_entrypoints
-            jf, reports = check_entrypoints()
+            eps = None
+            if args.entry:
+                from .entrypoints import select_entrypoints
+                eps, err = select_entrypoints(args.entry)
+                if err:
+                    print(f"error: {err}", file=sys.stderr)
+                    return 2
+            jf, reports = check_entrypoints(eps)
             if rules is not None:
                 # entrypoint-error bypasses the filter: "this entry point
                 # could not be analyzed" must never read as "clean under
@@ -156,6 +173,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             def in_scope(entry) -> bool:
                 p = entry["path"]
                 if p.startswith("entrypoint:"):
+                    if args.entry and p[len("entrypoint:"):] not in args.entry:
+                        return False  # --entry: unselected entries carry over
                     return not args.no_jaxpr and (
                         rules is None or entry["rule"] in rules
                         or entry["rule"] == "entrypoint-error")

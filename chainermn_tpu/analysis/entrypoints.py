@@ -65,7 +65,12 @@ def _build_collective_ring() -> Dict[str, Any]:
     def run(v):
         return fn(jnp.asarray(v))
 
-    return {"trace": (run, (x,)), "bound_axes": {"mn"}}
+    return {"trace": (run, (x,)), "bound_axes": {"mn"},
+            # shard-flow: the ring's input is replicated by the P() feed
+            # — deliberately NOT annotated, so the finding lives in the
+            # checked-in .shardflow-baseline.json as the keeper proving
+            # the replication gate is live
+            "data_axis": "mn", "arg_labels": ("x",)}
 
 
 def _build_decode_tick() -> Dict[str, Any]:
@@ -117,7 +122,23 @@ def _build_decode_tick() -> Dict[str, Any]:
     return {"trace": (run, (params, jnp.asarray(tokens), caches,
                             jnp.asarray(pos))),
             "bound_axes": {"model"},
-            "variants": variants}
+            "variants": variants,
+            # shard-flow: TP shards the matmul weights over 'model';
+            # norm scales/biases stay replicated by the Megatron layout,
+            # and the KV pool rows are whole per replica at this
+            # registration's cache specs.  tokens/pos are deliberately
+            # UN-annotated: two tiny host-fed vectors kept as baseline
+            # keepers (with comments) proving the gate bites.
+            "data_axis": "model",
+            "arg_labels": ("params", "tokens", "caches", "pos"),
+            "expected_replication": {
+                "params": "Megatron TP layout: matmul weights shard "
+                          "over 'model', norm scales/biases/embedding "
+                          "remainders replicate by design",
+                "caches": "KV pool rows are whole per replica at the "
+                          "registered cache specs (TP>1 shards heads "
+                          "inside the flat K/V rows)",
+            }}
 
 
 def _build_prefill_family() -> Dict[str, Any]:
@@ -149,7 +170,17 @@ def _build_prefill_family() -> Dict[str, Any]:
     return {"trace": (lambda p, pr: jfn(p, pr), (params, jnp.asarray(p2))),
             "bound_axes": {"model"},
             "variants": (jfn, [(params, jnp.asarray(p2)),
-                               (params, jnp.asarray(p3))])}
+                               (params, jnp.asarray(p3))]),
+            "data_axis": "model",
+            "arg_labels": ("params", "prompt"),
+            "expected_replication": {
+                "params": "Megatron TP layout: matmul weights shard "
+                          "over 'model', norm scales/biases/embedding "
+                          "remainders replicate by design",
+                "prompt": "every TP rank consumes the full prompt "
+                          "(vocab-parallel embedding resolves its own "
+                          "vocab range)",
+            }}
 
 
 class _traced_obs_state:
@@ -243,12 +274,166 @@ def _build_flight_ring_program() -> Dict[str, Any]:
     return {"trace": (run_teed, args), "bound_axes": base["bound_axes"]}
 
 
+def _tiny_mlp_fixture():
+    """Shared tiny-MLP (params, batch) for the train-step entry points —
+    deterministic numpy, no jax PRNG (analysis must trace the same
+    program every run)."""
+    import numpy as np
+
+    rng = np.random.RandomState(_SEED)
+    params = {
+        "w1": rng.randn(8, 16).astype(np.float32) / 4,
+        "b1": np.zeros((16,), np.float32),
+        "w2": rng.randn(16, 4).astype(np.float32) / 4,
+        "b2": np.zeros((4,), np.float32),
+    }
+    batch = (rng.randn(4, 8).astype(np.float32),
+             rng.randint(0, 4, (4,)).astype(np.int32))
+    return params, batch
+
+
+def _build_train_step() -> Dict[str, Any]:
+    """The PRODUCTION train-step builder (`make_train_step` +
+    `create_multi_node_optimizer`/adam) — the program whose replication
+    report must name the full optimizer-state replication ZeRO-1
+    (ROADMAP item 2) will remove.  Its gradient all-reduce on the default
+    path is AUTODIFF-INSERTED and booked via ``comm.note`` — declared
+    here as a ``noted`` row (held byte-exact by the reconciliation) —
+    and on legacy jax the transpose of the loss pmean adds one scalar
+    psum equation no wrapper books (``ad_transpose_bytes``)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from chainermn_tpu import topology
+    from chainermn_tpu.optimizers import create_multi_node_optimizer
+    from chainermn_tpu.train import make_train_step
+
+    mesh = topology.make_nd_mesh(("mn",), (1,), jax.devices()[:1])
+    params, batch = _tiny_mlp_fixture()
+
+    def loss_fn(p, b):
+        x, y = b
+        h = jnp.tanh(x @ p["w1"] + p["b1"])
+        logits = h @ p["w2"] + p["b2"]
+        logp = jax.nn.log_softmax(logits)
+        return -jnp.take_along_axis(logp, y[:, None], axis=1).mean()
+
+    optimizer = create_multi_node_optimizer(optax.adam(1e-3), "mn")
+    # donate=False: the analyzer calls the step repeatedly on the same
+    # buffers (ledger run, then make_jaxpr) — donation would poison them
+    step = make_train_step(loss_fn, optimizer, mesh=mesh, donate=False)
+    opt_state = optimizer.init(params)
+
+    params_bytes = int(sum(
+        np.prod(v.shape) * v.dtype.itemsize
+        for v in jax.tree_util.tree_leaves(params)))
+
+    def run(p, s, b):
+        return step(p, s, b)
+
+    return {"trace": (run, (params, opt_state, batch)),
+            "bound_axes": {"mn"},
+            "data_axis": "mn",
+            "arg_labels": ("params", "opt_state", "batch"),
+            "expected_replication": {
+                "params": "data parallelism replicates parameters on "
+                          "every replica by definition",
+                "opt_state": "FULL optimizer-state replication — the "
+                             "exact blowup ZeRO-1 weight-update sharding "
+                             "(ROADMAP item 2, arxiv 2004.13336) removes; "
+                             "delete this annotation when it lands and "
+                             "the report diff goes red→green",
+            },
+            # the AD-inserted gradient psum, booked by train.py's
+            # comm.note at exactly the params' byte size
+            "noted": {"grad_allreduce_ad@mn": params_bytes},
+            # legacy jax: transpose(psum(loss)) is one more scalar psum
+            "ad_transpose_bytes": {"psum@mn": 4}}
+
+
+def _build_demo_train_step() -> Dict[str, Any]:
+    """The train CLI's demo step (`make_demo_step`): local grads + the
+    EXPLICIT accounted ring mean + accounted metric psums — no autodiff-
+    inserted collectives at all, so this entry reconciles with zero
+    declarations: every ledger row has its equation and vice versa."""
+    import jax
+    import optax
+
+    from chainermn_tpu import topology
+    from chainermn_tpu.train import make_demo_step
+
+    mesh = topology.make_nd_mesh(("mn",), (1,), jax.devices()[:1])
+    params, batch = _tiny_mlp_fixture()
+    optimizer = optax.sgd(1e-2, momentum=0.9)
+    step = make_demo_step(optimizer, mesh=mesh)
+    state = (params, optimizer.init(params))
+
+    def run(s, b):
+        return step(s, b)
+
+    return {"trace": (run, (state, batch)),
+            "bound_axes": {"mn"},
+            "data_axis": "mn",
+            "arg_labels": ("state", "batch"),
+            "expected_replication": {
+                "state": "the demo step replicates (params, momentum) "
+                         "per replica — same ZeRO-1 debt as train.step, "
+                         "tracked there per-argument",
+            }}
+
+
+def select_entrypoints(names=None, for_shardflow: bool = False):
+    """Resolve ``--entry`` names against the registry — the ONE resolver
+    both runners share (``cli.py`` and ``shardflow.main``).
+
+    Returns ``(entrypoints, error)``.  ``names=None`` selects everything
+    (minus ``shardflow=False`` entries when ``for_shardflow``).  An
+    unknown name is an error, and so is EXPLICITLY naming a
+    ``shardflow=False`` entry under ``for_shardflow`` — silently
+    analyzing 0 entry points would read as a clean verdict.
+    """
+    if not names:
+        eps = list(ENTRYPOINTS)
+        if for_shardflow:
+            eps = [ep for ep in eps if getattr(ep, "shardflow", True)]
+        return eps, None
+    by_name = {ep.name: ep for ep in ENTRYPOINTS}
+    unknown = [n for n in names if n not in by_name]
+    if unknown:
+        return None, (f"unknown entry point(s): {', '.join(unknown)} "
+                      f"(known: {', '.join(sorted(by_name))})")
+    eps = [by_name[n] for n in names]
+    if for_shardflow:
+        skipped = [ep.name for ep in eps
+                   if not getattr(ep, "shardflow", True)]
+        if skipped:
+            return None, (
+                f"entry point(s) registered shardflow=False — the base "
+                f"entry owns their compiled program's shard-flow "
+                f"analysis: {', '.join(skipped)}")
+    return eps, None
+
+
 ENTRYPOINTS = [
     EntryPoint(
         name="ops.collective.ring",
         build=_build_collective_ring,
         description="reduce_scatter+all_gather+shift+psum gradient ring "
                     "over axis 'mn' (the train CLI's demo reduction)"),
+    EntryPoint(
+        name="train.step",
+        build=_build_train_step,
+        description="make_train_step + MultiNodeOptimizer(adam) on a "
+                    "tiny MLP — the production DP step; replication "
+                    "report names the optimizer-state blowup ZeRO-1 "
+                    "removes (ROADMAP item 2)"),
+    EntryPoint(
+        name="train.demo_step",
+        build=_build_demo_train_step,
+        description="the train CLI's demo step: explicit accounted ring "
+                    "mean, fully reconciled with no declarations"),
     EntryPoint(
         name="parallel.decode.lm_decode_tick",
         build=_build_decode_tick,
@@ -263,6 +448,8 @@ ENTRYPOINTS = [
     EntryPoint(
         name="serving.tick_with_tracing",
         build=_build_tick_with_tracing,
+        shardflow=False,  # same compiled program as the decode tick —
+        #                   the base entry owns its shard-flow analysis
         description="serving decode tick with the tracer enabled and "
                     "the flight-recorder tee installed — observability "
                     "must stay host-side: one program, no tracer leak "
@@ -270,6 +457,7 @@ ENTRYPOINTS = [
     EntryPoint(
         name="observability.flight_ring",
         build=_build_flight_ring_program,
+        shardflow=False,  # same compiled program as ops.collective.ring
         description="accounted collective ring under the flight-"
                     "recorder comm tee — the ring records from host "
                     "callbacks only, leaving the traced program "

@@ -188,14 +188,17 @@ def load_baseline(path: str) -> Baseline:
     return Baseline(entries=entries, path=path)
 
 
-def find_baseline(start: str) -> Optional[str]:
-    """Walk up from ``start`` looking for the checked-in baseline file —
-    linter-config discovery, so the CLI works from any cwd."""
+def find_baseline(start: str,
+                  filename: str = BASELINE_FILENAME) -> Optional[str]:
+    """Walk up from ``start`` looking for a checked-in baseline file —
+    linter-config discovery, so the CLI works from any cwd.  One walk
+    serves both baselines (``filename``: the spmd-lint default here, the
+    shard-flow one via ``shardflow.find_shardflow_baseline``)."""
     d = os.path.abspath(start)
     if os.path.isfile(d):
         d = os.path.dirname(d)
     while True:
-        cand = os.path.join(d, BASELINE_FILENAME)
+        cand = os.path.join(d, filename)
         if os.path.exists(cand):
             return cand
         parent = os.path.dirname(d)

@@ -59,12 +59,29 @@ class EntryPoint:
       tuple is CALLED on ``jit_fn`` and the jit cache size compared to 1;
     * ``static_values`` (optional): values declared static somewhere in
       the program — probed for hashability.
+
+    Shard-flow keys (read by ``analysis/shardflow.py``; all optional):
+
+    * ``data_axis``: the mesh axis replication is judged against;
+    * ``arg_labels``: names for the positional trace args (replication
+      findings are grouped per label);
+    * ``expected_replication``: ``{label: reason}`` — replication that is
+      by design (or a named debt, e.g. optimizer state until ZeRO-1);
+      must be DELETED when the sharding lands (stale-annotation check);
+    * ``noted``: ``{ledger_row_key: bytes}`` — comm.note() bookings this
+      program performs (traffic no wrapper sees), held to account;
+    * ``ad_transpose_bytes``: ``{primitive@axis: bytes}`` — equations
+      legacy-jax autodiff adds by transposing a wrapped collective,
+      which the ledger cannot book (see shardflow module docs).
     """
 
     name: str
     build: Callable[[], Dict[str, Any]]
     allow_recompile: bool = False
     description: str = ""
+    #: False skips the shard-flow pass (for tee variants whose compiled
+    #: program an earlier entry already analyzes byte-for-byte).
+    shardflow: bool = True
 
 
 @dataclass

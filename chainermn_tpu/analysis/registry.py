@@ -22,11 +22,14 @@ import os
 from dataclasses import dataclass, field
 from typing import FrozenSet, Optional
 
-#: ops/collective.py defs that are *not* gang-synchronizing: helpers and
-#: per-rank queries.  Everything else public in that module is treated as
-#: a collective.  (axis_index/axis_size read topology, they don't sync.)
+#: ops/collective.py defs that are *not* gang-synchronizing: helpers,
+#: per-rank queries, and the static cost-model faces.  Everything else
+#: public in that module is treated as a collective.  (axis_index/
+#: axis_size read topology, they don't sync; the *_cost functions are
+#: pure arithmetic the shard-flow analyzer and bench share.)
 _NON_COLLECTIVE_OPS = frozenset({
     "zeros_like_vma", "axis_index", "axis_size",
+    "collective_wire_cost", "quantized_ring_cost",
 })
 
 #: jax.lax collective primitives (the fixed upstream vocabulary the named

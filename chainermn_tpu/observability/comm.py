@@ -52,11 +52,16 @@ def _as_dtype(dt) -> np.dtype:
         return np.dtype(getattr(jnp, str(dt)))
 
 
-def _payload_info(tree) -> tuple:
+def payload_info(tree) -> tuple:
     """``(nbytes, dtype_str, n_elements, in_jit)`` over a pytree's leaves.
 
     Works on concrete arrays and on tracers (via ``aval``) so the same
-    accounting serves the eager and in-jit faces.
+    accounting serves the eager and in-jit faces.  This function IS the
+    ledger's byte convention — one logical payload per call, shape ×
+    itemsize, independent of axis size — and the static cost model
+    computes its per-equation bytes through it
+    (``analysis.shardflow._aval_nbytes`` feeds avals in), so the two
+    sides of the reconciliation can never diverge on the formula.
     """
     import jax
 
@@ -110,7 +115,16 @@ class CommAccountant:
 
     # ---- recording ----
     def record(self, op: str, axis, nbytes: int, dtype: str,
-               in_jit: bool, latency_s: Optional[float] = None) -> None:
+               in_jit: bool, latency_s: Optional[float] = None,
+               noted: bool = False) -> None:
+        """``noted=True`` marks a DECLARED collective (booked via
+        :func:`note` — the host's knowledge of traffic no wrapper sees,
+        e.g. the autodiff-inserted gradient psum).  Noted bytes
+        accumulate in a separate ``noted_bytes`` field on the row, so a
+        key shared between wrapped calls and notes (rows aggregate per
+        ``op@axis``) still splits exactly — the shard-flow
+        reconciliation holds wrapped bytes to the traced equations and
+        noted bytes to the entry point's declaration."""
         axis_key = "+".join(axis) if isinstance(axis, (tuple, list)) else str(axis)
         key = f"{op}@{axis_key}"
         with self._lock:
@@ -120,6 +134,8 @@ class CommAccountant:
             row["bytes"] += int(nbytes)
             if latency_s is not None:
                 row["host_time_s"] += float(latency_s)
+            if noted:
+                row["noted_bytes"] = row.get("noted_bytes", 0) + int(nbytes)
             # a key can aggregate calls of several dtypes (fp32 loss +
             # int32 counters through the same psum@axis) — keep the set
             dts = row.setdefault("dtypes", [])
@@ -132,12 +148,18 @@ class CommAccountant:
                 srow["bytes"] += int(nbytes)
                 if latency_s is not None:
                     srow["host_time_s"] += float(latency_s)
+                if noted:
+                    srow["noted_bytes"] = (srow.get("noted_bytes", 0)
+                                           + int(nbytes))
                 if in_jit:
                     self._step_traced = True
                     jrow = self._step_jit.setdefault(
                         key, {"calls": 0, "bytes": 0, "host_time_s": 0.0})
                     jrow["calls"] += 1
                     jrow["bytes"] += int(nbytes)
+                    if noted:
+                        jrow["noted_bytes"] = (jrow.get("noted_bytes", 0)
+                                               + int(nbytes))
         tr = trace.get_tracer()
         tr.add_counter(f"comm/{op}/bytes", nbytes)
         tr.add_counter(f"comm/{op}/calls", 1)
@@ -204,6 +226,10 @@ class CommAccountant:
                                     "host_time_s": 0.0})
                             row["calls"] += v["calls"]
                             row["bytes"] += v["bytes"]
+                            if v.get("noted_bytes"):
+                                row["noted_bytes"] = (
+                                    row.get("noted_bytes", 0)
+                                    + v["noted_bytes"])
                 self.last_step_report = self._summarize(accum)
             # mirror the replayed bookings into the trace counter tracks
             # (outside our lock — the tracer takes its own), so the
@@ -257,8 +283,8 @@ def note(op: str, axis, tree) -> None:
     collective."""
     if not trace.get_tracer().enabled:
         return
-    nbytes, dtype, _, in_jit = _payload_info(tree)
-    _ACCOUNTANT.record(op, axis, nbytes, dtype, in_jit=in_jit)
+    nbytes, dtype, _, in_jit = payload_info(tree)
+    _ACCOUNTANT.record(op, axis, nbytes, dtype, in_jit=in_jit, noted=True)
 
 
 def collective(op: str, axis, x, thunk, wire_dtype=None):
@@ -270,7 +296,7 @@ def collective(op: str, axis, x, thunk, wire_dtype=None):
     tr = trace.get_tracer()
     if not tr.enabled:
         return thunk()
-    nbytes, dtype, n_elems, in_jit = _payload_info(x)
+    nbytes, dtype, n_elems, in_jit = payload_info(x)
     if wire_dtype is not None:
         wd = _as_dtype(wire_dtype)
         dtype = str(wd)
@@ -311,7 +337,7 @@ def accounted_method(op: str):
             tr = trace.get_tracer()
             if not tr.enabled or getattr(_EAGER_DEPTH, "d", 0):
                 return fn(self, x, *args, **kwargs)
-            nbytes, dtype, _, _ = _payload_info(x)
+            nbytes, dtype, _, _ = payload_info(x)
             axis = getattr(self, "axis_name", "world")
             _EAGER_DEPTH.d = 1
             t0 = time.perf_counter()
@@ -327,3 +353,7 @@ def accounted_method(op: str):
         wrapper._obs_wrapped = True
         return wrapper
     return deco
+
+
+#: Back-compat alias (the helper predates its public face).
+_payload_info = payload_info

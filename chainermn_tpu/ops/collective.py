@@ -27,6 +27,114 @@ from ..observability.comm import collective as _acc
 from ..topology import DEFAULT_AXIS_NAME
 
 
+#: Ledger-op → jaxpr collective primitive: which equation each wrapper's
+#: wire leg lowers to.  This is the join key of the static↔dynamic
+#: reconciliation (``analysis/shardflow.py``): the runtime comm ledger is
+#: keyed by WRAPPER name (``reduce_scatter@mn``), the traced program by
+#: PRIMITIVE name (``psum_scatter`` / this jax's ``reduce_scatter``), and
+#: several wrappers share one primitive (``psum``/``pmean``/the autodiff
+#: grad note all land on ``psum``), so reconciliation happens per
+#: primitive group.  ``None`` marks a COMPOSITE op whose wire legs are a
+#: hand-written schedule (the quantized int8 ring: ppermute/psum pairs at
+#: the wire dtype plus fp32 scales) — its cost comes from
+#: :func:`quantized_ring_cost`, not from a single equation.  Kept as a
+#: literal so the jax-free analysis registry can read it by parsing.
+LEDGER_TO_PRIMITIVE = {
+    "psum": "psum",
+    "pmean": "psum",
+    "pmax": "pmax",
+    "pmin": "pmin",
+    "pmean_if_bound": "psum",
+    "all_gather": "all_gather",
+    "all_to_all": "all_to_all",
+    "reduce_scatter": "psum_scatter",
+    "ppermute": "ppermute",
+    "shift": "ppermute",
+    "bcast": "all_gather",
+    "hierarchical_pmean": "psum",
+    "quantized_ring_pmean": None,
+    # comm.note() declarations used by the shipped builders (train.py):
+    # the autodiff-inserted cross-rank gradient psum.
+    "grad_allreduce_ad": "psum",
+}
+
+
+def collective_wire_cost(primitive: str, payload_bytes: int,
+                         axis_size: int) -> dict:
+    """Physical wire cost of ONE collective equation on a ring schedule:
+    ``{"wire_bytes": per-rank bytes on the wire, "messages": per-rank
+    message count}``.
+
+    ``payload_bytes`` follows the LEDGER convention (the input payload of
+    the call — ``observability.comm.payload_info``); this function maps
+    it to the ring decomposition every textbook (and XLA's default ICI
+    schedule) uses: an all-reduce is reduce-scatter + all-gather, each
+    moving ``(P-1)/P`` of the payload over ``P-1`` hops.  At axis size 1
+    everything is free.  Used by the shard-flow cost model and the bench
+    wire-byte gate — one formula, not two.
+    """
+    p = int(axis_size)
+    if p <= 1:
+        return {"wire_bytes": 0, "messages": 0}
+    b = int(payload_bytes)
+    if primitive in ("psum", "pmax", "pmin"):            # all-reduce
+        return {"wire_bytes": 2 * b * (p - 1) // p, "messages": 2 * (p - 1)}
+    if primitive in ("psum_scatter", "reduce_scatter"):  # reduce-scatter
+        return {"wire_bytes": b * (p - 1) // p, "messages": p - 1}
+    if primitive == "all_gather":   # payload = the PER-RANK input block
+        return {"wire_bytes": b * (p - 1), "messages": p - 1}
+    if primitive == "all_to_all":
+        return {"wire_bytes": b * (p - 1) // p, "messages": p - 1}
+    if primitive in ("ppermute", "pshuffle"):
+        return {"wire_bytes": b, "messages": 1}
+    return {"wire_bytes": b, "messages": 1}  # unknown: conservative
+
+
+def quantized_ring_cost(n_elements: int, axis_size: int,
+                        wire_dtype="int8") -> dict:
+    """Analytic wire cost of :func:`quantized_ring_pmean` — the composite
+    op ``LEDGER_TO_PRIMITIVE`` maps to ``None``.
+
+    Returns ``{"ledger_bytes", "wire_bytes", "scale_bytes", "messages"}``
+    per rank: ``ledger_bytes`` is what the accountant books for the call
+    (``n_elements × itemsize(wire_dtype)`` — the documented compressed-
+    wire convention), ``wire_bytes`` the physical payload hops (the
+    reduce-scatter phase re-quantizes and forwards one ``N/P`` chunk per
+    hop for ``P-1`` hops, the all-gather phase is one psum of a one-hot
+    ``N``-row buffer), and ``scale_bytes`` the fp32 per-chunk scales that
+    ride alongside — the dtype-dependent padding the reconciliation
+    contract tolerates (docs/ANALYSIS.md).
+    """
+    p = int(axis_size)
+    item = _as_wire_itemsize(wire_dtype)
+    n = int(n_elements)
+    if p <= 1:
+        return {"ledger_bytes": 0, "wire_bytes": 0, "scale_bytes": 0,
+                "messages": 0}
+    chunk = -(-n // p)  # padded chunk length
+    rs_bytes = (p - 1) * chunk * item
+    ag_bytes = 2 * (p * chunk * item) * (p - 1) // p  # psum of one-hot buffer
+    scales = (p - 1) * 4 + 2 * (p * 4) * (p - 1) // p
+    return {
+        "ledger_bytes": n * item,
+        "wire_bytes": rs_bytes + ag_bytes,
+        "scale_bytes": scales,
+        # the FULL physical schedule, scale traffic included: the RS
+        # phase sends 2 ppermutes per hop (q + scale) over p-1 hops, the
+        # AG phase is TWO ring all-reduces (psum of buf_q and of buf_s)
+        # at 2(p-1) messages each — 6(p-1) total
+        "messages": 2 * (p - 1) + 2 * (2 * (p - 1)),
+    }
+
+
+def _as_wire_itemsize(wire_dtype) -> int:
+    # one dtype-coercion fallback for the whole codebase: the
+    # accountant's (np.dtype, else getattr(jnp, name)) rule
+    from ..observability.comm import _as_dtype
+
+    return _as_dtype(wire_dtype).itemsize
+
+
 def _axis_bound(axis_name) -> bool:
     """True when `axis_name` (a name or tuple of names) is bound in the
     current trace.

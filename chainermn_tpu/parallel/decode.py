@@ -226,15 +226,19 @@ def _greedy_token(table, h_last, axis_name: str):
     :func:`lm_generate` (``temperature=0``) and the serving engine's
     per-tick step, so batched-slot decode is token-exact against the
     closed-batch generator."""
+    from ..ops import collective as _col
+
     vocab_per = table.shape[0]
     start = jax.lax.axis_index(axis_name) * vocab_per
     logits = jnp.einsum("bd,vd->bv", h_last, table,
                         preferred_element_type=jnp.float32)
     local_best = logits.max(-1)
     local_idx = start + logits.argmax(-1)
-    gbest = jax.lax.pmax(local_best, axis_name)
+    # accounted face: the serving tick's argmax pair must be ledger-
+    # visible for the shard-flow static↔dynamic reconciliation
+    gbest = _col.pmax(local_best, axis_name)
     winner = (local_best == gbest)
-    return jax.lax.pmin(
+    return _col.pmin(
         jnp.where(winner, local_idx, jnp.int32(2 ** 30)), axis_name)
 
 

@@ -39,7 +39,16 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..ops import collective as _col
 from ..topology import DEFAULT_AXIS_NAME
+
+# The TP wire legs below route through the ACCOUNTED collective face
+# (`ops.collective`) instead of raw `jax.lax`: numerically identical (the
+# wrapper is one attribute read before dispatching to jax.lax), but every
+# psum/all_gather a serving tick or TP forward performs now lands in the
+# PR 1 comm ledger — which is what lets the shard-flow analyzer
+# (analysis/shardflow.py) reconcile the static cost model against runtime
+# bytes for the serving entry points.
 
 
 def column_parallel_dense(x, kernel, bias=None, *, axis_name: str,
@@ -56,7 +65,7 @@ def column_parallel_dense(x, kernel, bias=None, *, axis_name: str,
     if bias is not None:
         y = y + bias
     if gather_output:
-        y = jax.lax.all_gather(y, axis_name, axis=y.ndim - 1, tiled=True)
+        y = _col.all_gather(y, axis_name, axis=y.ndim - 1, tiled=True)
     return y
 
 
@@ -78,7 +87,7 @@ def row_parallel_dense(x, kernel, bias=None, *, axis_name: str,
     y = jnp.matmul(x, kernel, preferred_element_type=jnp.float32)
     # Reduce in fp32: casting the partials to bf16 BEFORE the psum would
     # accumulate the cross-chip sum at bf16, losing precision with axis size.
-    y = jax.lax.psum(y, axis_name)
+    y = _col.psum(y, axis_name)
     if bias is not None:
         y = y + bias
     return y.astype(x.dtype)
@@ -98,7 +107,7 @@ def vocab_parallel_embedding(ids, table, *, axis_name: str):
     in_range = (local >= 0) & (local < vocab_per)
     rows = jnp.take(table, jnp.clip(local, 0, vocab_per - 1), axis=0)
     rows = jnp.where(in_range[..., None], rows, 0)
-    return jax.lax.psum(rows, axis_name)
+    return _col.psum(rows, axis_name)
 
 
 def tp_mlp(x, params, *, axis_name: str,

@@ -15,6 +15,8 @@ Usage::
     python scripts/lint_spmd.py chainermn_tpu/ examples/ scripts/
     python scripts/lint_spmd.py --no-jaxpr --json chainermn_tpu/
     python scripts/lint_spmd.py --fix-baseline chainermn_tpu/   # accept
+    python scripts/lint_spmd.py --entry train.step chainermn_tpu/train.py
+    #   ^ jaxpr checks on ONE registered entry point (fast iteration)
 """
 
 import importlib.util

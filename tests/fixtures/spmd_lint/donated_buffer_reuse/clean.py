@@ -1,0 +1,28 @@
+"""CLEAN: donated buffers are rebound (the canonical train loop)."""
+import jax
+
+step = jax.jit(lambda p, b: p, donate_argnums=(0,))
+
+
+def rebound(params, batch):
+    params = step(params, batch)    # rebinding consumes the donation
+    return params["w"].sum()
+
+
+def rebound_loop(params, batches):
+    for b in batches:
+        params = step(params, b)    # fresh buffer every iteration
+    return params
+
+
+def exclusive_branches(params, batch, on_device):
+    if on_device:
+        out = step(params, batch)   # donates only on this path...
+    else:
+        out = params                # ...so this read can never race it
+    return out
+
+
+def pool_row_rebound(pool, batch):
+    pool.caches = step(pool.caches, batch)  # attribute rebinding
+    return pool.caches              # consumes the donation

@@ -1,0 +1,26 @@
+"""BAD: shard_map bindings whose specs contradict the body's axes."""
+import jax
+from jax.sharding import PartitionSpec as P
+
+from chainermn_tpu.ops.collective import psum
+from chainermn_tpu.topology import make_nd_mesh
+
+
+def wrong_mesh_axis(x):
+    mesh = make_nd_mesh(("data",), (1,), jax.devices()[:1])
+
+    def body(v):
+        return psum(v, "model")     # axis the mesh never binds
+
+    return jax.shard_map(body, mesh=mesh, in_specs=(P("data"),),
+                         out_specs=P())(x)
+
+
+def reduced_output_sharded(x):
+    mesh = make_nd_mesh(("mn",), (1,), jax.devices()[:1])
+
+    def body(v):
+        return psum(v, "mn")        # result is REPLICATED over 'mn'...
+
+    return jax.shard_map(body, mesh=mesh, in_specs=(P("mn"),),
+                         out_specs=P("mn"))(x)  # ...but out_specs shard it

@@ -1,0 +1,26 @@
+"""CLEAN: specs and body axes agree (the train-step shape)."""
+import jax
+from jax.sharding import PartitionSpec as P
+
+from chainermn_tpu.ops.collective import psum
+from chainermn_tpu.topology import make_nd_mesh
+
+
+def matching_axes(x):
+    mesh = make_nd_mesh(("mn",), (1,), jax.devices()[:1])
+
+    def body(v):
+        return psum(v, "mn")        # replicated result...
+
+    return jax.shard_map(body, mesh=mesh, in_specs=(P("mn"),),
+                         out_specs=P())(x)   # ...declared replicated
+
+
+def sharded_passthrough(x):
+    mesh = make_nd_mesh(("mn",), (1,), jax.devices()[:1])
+
+    def body(v):
+        return v * 2                # no reduction: stays rank-varying
+
+    return jax.shard_map(body, mesh=mesh, in_specs=(P("mn"),),
+                         out_specs=P("mn"))(x)

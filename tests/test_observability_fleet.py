@@ -361,7 +361,11 @@ def test_every_collective_wrapper_books_through_accountant():
     # in-jit face: public functions must call _acc(...) (or be on the
     # explicit non-collective allowlist)
     non_collectives = {"axis_index", "axis_size", "zeros_like_vma",
-                       "pmean_if_bound"}  # pmean_if_bound delegates to pmean
+                       "pmean_if_bound",  # delegates to pmean
+                       # pure-arithmetic cost-model faces (ISSUE 6):
+                       # consumed by analysis/shardflow.py and bench.py,
+                       # they never touch the wire
+                       "collective_wire_cost", "quantized_ring_cost"}
     for name, fn in vars(col).items():
         if name.startswith("_") or not inspect.isfunction(fn):
             continue

@@ -1,0 +1,579 @@
+"""Shard-flow analyzer tier-1 gate (``pytest -m lint``) — ISSUE 6.
+
+Four layers:
+
+* **reconciliation** — for every registered entry point the statically
+  predicted per-collective wire bytes equal the PR 1 runtime comm
+  ledger's accounted bytes (the acceptance criterion: the cost model can
+  never silently rot), and synthetic broken entries prove each gap class
+  actually fires;
+* **replication report** — the current train step names the full
+  optimizer-state replication ZeRO-1 (ROADMAP item 2) will remove, and
+  the annotation machinery is live in both directions (unexpected +
+  stale);
+* **cost model units** — the ring formulas, the quantized int8 ring
+  analytic model (validated against the real ledger AND the real jaxpr
+  in a 2-virtual-device subprocess), liveness peak memory, scan trip
+  counts;
+* **self-run** — the shipped registration is clean modulo the
+  checked-in ``.shardflow-baseline.json`` (commented keepers, stale
+  check, delete-fails-gate), and ``scripts/shardflow_report.py`` honors
+  the 0/1/2 exit contract incl. ``--entry`` and ``--fix-baseline``.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from chainermn_tpu.analysis.findings import load_baseline
+from chainermn_tpu.analysis.jaxpr_engine import EntryPoint
+from chainermn_tpu.analysis import shardflow
+
+pytestmark = pytest.mark.lint
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BASELINE = os.path.join(REPO, ".shardflow-baseline.json")
+
+
+@pytest.fixture(scope="module")
+def full_run():
+    """One shared analysis sweep over all registered entry points —
+    module-scoped: each entry's build+execute+trace is paid once."""
+    findings, reports = shardflow.analyze_entrypoints()
+    return findings, {r.name: r for r in reports}
+
+
+# --------------------------------------------------------------------------
+# static <-> dynamic reconciliation (the acceptance criterion)
+# --------------------------------------------------------------------------
+
+class TestReconciliation:
+    def test_every_entrypoint_reconciles(self, full_run):
+        findings, by_name = full_run
+        for name, r in by_name.items():
+            assert r.error is None, (name, r.error)
+            assert r.reconciled is True, (
+                name, r.static_groups, r.expected_static, r.ledger_noted)
+        bad = [f for f in findings
+               if f.rule in ("comm-ledger-gap", "shardflow-error")]
+        assert bad == [], [f.message for f in bad]
+
+    def test_ring_groups_byte_exact(self, full_run):
+        _, by_name = full_run
+        r = by_name["ops.collective.ring"]
+        # all four wire legs of the demo ring, ledger == program
+        assert set(r.static_groups) == {
+            "psum_scatter@mn", "all_gather@mn", "ppermute@mn", "psum@mn"}
+        assert r.static_groups == r.expected_static == r.ledger_wrapped
+
+    def test_train_step_noted_row_held_to_account(self, full_run):
+        _, by_name = full_run
+        r = by_name["train.step"]
+        # the AD-inserted gradient psum is booked via comm.note at
+        # exactly the params' byte size, and declared on the entry
+        assert list(r.ledger_noted) == ["grad_allreduce_ad@mn"]
+        assert r.ledger_noted["grad_allreduce_ad@mn"] == \
+            r.replication["args"]["params"]["total_bytes"]
+
+    def test_serving_tick_psums_are_ledger_visible(self, full_run):
+        # regression for the PR's tensor_parallel accounting change: the
+        # TP forward's psums (embed + wo + mlp) must be booked, not just
+        # traced — before this PR the serving tick was ledger-invisible
+        _, by_name = full_run
+        r = by_name["parallel.decode.lm_decode_tick"]
+        assert r.ledger_wrapped.get("psum@model", 0) > 0
+        assert r.ledger_wrapped == r.static_groups
+
+    def test_wrong_noted_declaration_is_a_gap(self):
+        from chainermn_tpu.analysis.entrypoints import _build_train_step
+
+        def build():
+            spec = _build_train_step()
+            spec["noted"] = {"grad_allreduce_ad@mn": 1}  # drifted
+            return spec
+
+        findings, report = shardflow.analyze_entrypoint(
+            EntryPoint(name="synthetic.bad_noted", build=build))
+        assert report.reconciled is False
+        assert any(f.rule == "comm-ledger-gap"
+                   and "declares 1" in f.message for f in findings)
+
+    def test_unaccounted_collective_is_a_gap(self):
+        """A raw jax.lax collective (bypassing the accounted face) shows
+        up in the program but never in the ledger — the exact rot class
+        the reconciliation exists to catch."""
+
+        def build():
+            import jax
+            import numpy as np
+
+            from chainermn_tpu import topology
+            from chainermn_tpu._compat import shard_map
+            from jax.sharding import PartitionSpec as P
+
+            mesh = topology.make_nd_mesh(("mn",), (1,), jax.devices()[:1])
+
+            def body(x):
+                return jax.lax.psum(x, "mn")  # raw: ledger never sees it
+
+            fn = shard_map(body, mesh=mesh, in_specs=(P(),), out_specs=P())
+            return {"trace": (lambda v: fn(v), (np.ones((4,), np.float32),)),
+                    "bound_axes": {"mn"}}
+
+        findings, report = shardflow.analyze_entrypoint(
+            EntryPoint(name="synthetic.raw_psum", build=build))
+        assert report.reconciled is False
+        gaps = [f for f in findings if f.rule == "comm-ledger-gap"]
+        assert gaps and "psum@mn" in gaps[0].message
+
+    def test_broken_build_is_reported_not_raised(self):
+        def build():
+            raise RuntimeError("subsystem drifted")
+
+        findings, report = shardflow.analyze_entrypoint(
+            EntryPoint(name="synthetic.broken", build=build))
+        assert report.error and "subsystem drifted" in report.error
+        assert [f.rule for f in findings] == ["shardflow-error"]
+
+
+# --------------------------------------------------------------------------
+# replication report (the ZeRO-1 red→green mechanism)
+# --------------------------------------------------------------------------
+
+class TestReplication:
+    def test_train_step_names_optimizer_state_blowup(self, full_run):
+        # ISSUE 6 acceptance: the report for the CURRENT train step names
+        # the full optimizer-state replication ROADMAP item 2 removes
+        _, by_name = full_run
+        args = by_name["train.step"].replication["args"]
+        opt = args["opt_state"]
+        assert opt["fully_replicated"] is True
+        assert opt["replicated_bytes"] == opt["total_bytes"] > 0
+        assert "ZeRO-1" in opt["expected"]
+        assert "params" in args and args["params"]["fully_replicated"]
+        # the data is actually data-parallel: batch shards over the axis
+        assert args["batch"]["replicated_bytes"] == 0
+
+    def test_unexpected_replication_fires_without_annotation(self):
+        findings, _ = shardflow.analyze_entrypoint(
+            _synthetic_replicated_entry(expected=None))
+        hits = [f for f in findings if f.rule == "unexpected-replication"]
+        assert len(hits) == 1 and hits[0].context == "w"
+
+    def test_while_loop_carry_keeps_varying_axes(self):
+        """Review fix: a while_loop eqn's invars are cond_consts +
+        body_consts + carry while the body jaxpr sees only body_consts +
+        carry — a positional zip dropped the carry's varying axes, so a
+        rank-varying carry read as replicated (poisoning the ZeRO-1
+        gating).  Both loop closures capture consts to force nonzero
+        cond_nconsts/body_nconsts."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from chainermn_tpu import topology
+        from chainermn_tpu._compat import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        mesh = topology.make_nd_mesh(("mn",), (1,), jax.devices()[:1])
+        limit = jnp.float32(100.0)
+        inc = jnp.float32(1.0)
+
+        def body(x):
+            # x enters rank-VARYING (in_specs P("mn"))
+            def cond(c):
+                return c.sum() < limit      # limit -> cond_consts
+
+            def wbody(c):
+                return c + inc              # inc -> body_consts
+
+            y = jax.lax.while_loop(cond, wbody, x)
+            return jax.lax.psum(y, "mn")
+
+        fn = shard_map(body, mesh=mesh, in_specs=(P("mn"),),
+                       out_specs=P(), check_vma=False)
+        x = np.zeros((4,), np.float32)
+        jaxpr = jax.make_jaxpr(lambda v: fn(v))(x)
+        rep = shardflow.replication_report(jaxpr, (x,), "mn", ("x",))
+        # the input is sharded...
+        assert rep["args"]["x"]["replicated_bytes"] == 0
+        # ...and the while carry must STAY varying: no 'while'
+        # intermediate may appear in the replicated list
+        prims = [it["primitive"] for it in rep["intermediates"]]
+        assert "while" not in prims, rep["intermediates"]
+
+    def test_annotation_silences_and_stale_annotation_fires(self):
+        # annotated replicated arg: silent
+        findings, report = shardflow.analyze_entrypoint(
+            _synthetic_replicated_entry(expected={"w": "by design"}))
+        assert [f for f in findings
+                if f.rule == "unexpected-replication"] == []
+        assert report.replication["args"]["w"]["expected"] == "by design"
+        # annotation for a SHARDED arg: the red→green diff mechanism
+        findings, _ = shardflow.analyze_entrypoint(
+            _synthetic_replicated_entry(expected={"x": "sharded already"}))
+        assert any(f.rule == "stale-replication-annotation"
+                   and f.context == "x" for f in findings)
+
+
+def _synthetic_replicated_entry(expected):
+    def build():
+        import jax
+        import numpy as np
+
+        from chainermn_tpu import topology
+        from chainermn_tpu._compat import shard_map
+        from chainermn_tpu.ops import collective as C
+        from jax.sharding import PartitionSpec as P
+
+        mesh = topology.make_nd_mesh(("mn",), (1,), jax.devices()[:1])
+
+        def body(x, w):
+            return C.psum(x @ w, "mn")
+
+        fn = shard_map(body, mesh=mesh, in_specs=(P("mn"), P()),
+                       out_specs=P())
+        x = np.ones((2, 3), np.float32)
+        w = np.ones((3, 4), np.float32)
+        spec = {"trace": (lambda a, b: fn(a, b), (x, w)),
+                "bound_axes": {"mn"}, "data_axis": "mn",
+                "arg_labels": ("x", "w")}
+        if expected is not None:
+            spec["expected_replication"] = expected
+        return spec
+
+    return EntryPoint(name="synthetic.replicated", build=build)
+
+
+# --------------------------------------------------------------------------
+# cost model + liveness units
+# --------------------------------------------------------------------------
+
+class TestCostModel:
+    def test_ring_formulas(self):
+        from chainermn_tpu.ops.collective import collective_wire_cost as cwc
+
+        assert cwc("psum", 1024, 1) == {"wire_bytes": 0, "messages": 0}
+        assert cwc("psum", 1024, 4) == {"wire_bytes": 1536, "messages": 6}
+        assert cwc("psum_scatter", 1024, 4) == {"wire_bytes": 768,
+                                                "messages": 3}
+        assert cwc("all_gather", 256, 4) == {"wire_bytes": 768,
+                                             "messages": 3}
+        assert cwc("ppermute", 1024, 4) == {"wire_bytes": 1024,
+                                            "messages": 1}
+
+    def test_quantized_ring_ledger_convention(self):
+        from chainermn_tpu.ops.collective import quantized_ring_cost
+
+        c = quantized_ring_cost(1 << 20, 8, "int8")
+        assert c["ledger_bytes"] == 1 << 20          # ~1 byte/element
+        # full schedule incl. scale traffic: 2(P-1) RS ppermute pairs +
+        # two AG ring all-reduces (buf_q, buf_s) at 2(P-1) each
+        assert c["messages"] == 6 * 7
+        assert quantized_ring_cost(64, 1)["wire_bytes"] == 0
+
+    @pytest.mark.slow
+    def test_quantized_ring_model_matches_ledger_and_jaxpr(self):
+        """2 virtual CPU devices: the analytic model equals BOTH the
+        runtime ledger row (ledger convention) and the traced program's
+        int8 wire equations (physical convention) — the quantized path's
+        own static↔dynamic reconciliation."""
+        code = textwrap.dedent("""
+            import os
+            os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                + " --xla_force_host_platform_device_count=2")
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            import jax, numpy as np, jax.numpy as jnp
+            from jax.sharding import PartitionSpec as P
+            from chainermn_tpu._compat import shard_map
+            from chainermn_tpu import topology, observability as obs
+            from chainermn_tpu.ops import collective as C
+            from chainermn_tpu.ops.collective import quantized_ring_cost
+            from chainermn_tpu.observability.comm import get_accountant
+            from chainermn_tpu.analysis import shardflow
+
+            mesh = topology.make_nd_mesh(("mn",), (2,), jax.devices()[:2])
+            fn = shard_map(lambda x: C.quantized_ring_pmean(x, "mn"),
+                           mesh=mesh, in_specs=(P(),), out_specs=P(),
+                           check_vma=False)
+            x = jnp.ones((64,), jnp.float32)
+            obs.enable()
+            np.asarray(fn(x))
+            row = get_accountant().totals["quantized_ring_pmean@mn"]
+            cost = quantized_ring_cost(64, 2, "int8")
+            assert row["bytes"] == cost["ledger_bytes"], (row, cost)
+
+            jaxpr = jax.make_jaxpr(fn)(x)
+            costs = shardflow.static_costs(jaxpr)
+            int8_wire = sum(c.wire_bytes for c in costs
+                            if c.dtype == "int8")
+            f32_wire = sum(c.wire_bytes for c in costs
+                           if c.dtype == "float32")
+            assert int8_wire == cost["wire_bytes"], (int8_wire, cost)
+            assert f32_wire == cost["scale_bytes"], (f32_wire, cost)
+            print("OK")
+        """)
+        r = subprocess.run([sys.executable, "-c", code], cwd=REPO,
+                           capture_output=True, text=True, timeout=600)
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "OK" in r.stdout
+
+    def test_scan_trip_counts_reported_not_reconciled(self):
+        """A psum inside lax.scan executes `length` times per step but
+        books ONCE at trace time — the static model mirrors the ledger
+        convention for reconciliation and carries the multiplier for the
+        physical report."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from chainermn_tpu import topology
+        from chainermn_tpu._compat import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        mesh = topology.make_nd_mesh(("mn",), (1,), jax.devices()[:1])
+
+        def body(x):
+            def inner(c, _):
+                return jax.lax.psum(c, "mn"), None
+            y, _ = jax.lax.scan(inner, x, None, length=5)
+            return y
+
+        fn = shard_map(body, mesh=mesh, in_specs=(P(),), out_specs=P())
+        jaxpr = jax.make_jaxpr(lambda v: fn(v))(np.ones((4,), np.float32))
+        costs = [c for c in shardflow.static_costs(jaxpr)
+                 if c.primitive == "psum"]
+        assert [c.trip_count for c in costs] == [5]
+        assert shardflow.group_bytes(costs) == {"psum@mn": 16}
+        assert shardflow.group_bytes(costs, trip_adjusted=True) == {
+            "psum@mn": 80}
+        del jnp  # imported for parity with sibling tests
+
+
+class TestPeakLive:
+    def test_straight_line_chain(self):
+        import jax
+        import numpy as np
+
+        def f(x):
+            y = x * 2.0
+            z = y * 3.0
+            return z
+
+        jaxpr = jax.make_jaxpr(f)(np.ones((4,), np.float32))
+        # x(16) lives through eqn1 only; peak = x + y = y + z = 32
+        assert shardflow.peak_live_bytes(jaxpr) == 32
+
+    def test_fanout_holds_both_operands(self):
+        import jax
+        import numpy as np
+
+        def f(x):
+            y = x * 2.0
+            z = x * 3.0          # x still live here
+            return y + z
+
+        jaxpr = jax.make_jaxpr(f)(np.ones((100,), np.float32))
+        # at eqn2: x + y + z live = 1200 bytes
+        assert shardflow.peak_live_bytes(jaxpr) == 1200
+
+    def test_entrypoint_reports_carry_peak(self, full_run):
+        _, by_name = full_run
+        for name, r in by_name.items():
+            assert r.peak_live_bytes and r.peak_live_bytes > 0, name
+        # the train step must hold at least params + opt state + batch
+        r = by_name["train.step"]
+        lower_bound = sum(g["total_bytes"]
+                          for g in r.replication["args"].values())
+        assert r.peak_live_bytes >= lower_bound
+
+
+# --------------------------------------------------------------------------
+# merge_trace_shards × comm accounting (ISSUE 6 satellite)
+# --------------------------------------------------------------------------
+
+class TestCrossRankCommMerge:
+    def test_per_rank_ledger_survives_merge_and_sums_to_static(
+            self, tmp_path):
+        """Two synthetic rank shards of the accounted ring: each rank's
+        comm counters survive ``merge_trace_shards`` on its own pid
+        lane, and the per-rank ledgered bytes sum to the static
+        prediction × world size."""
+        import chainermn_tpu.observability as obs
+        from chainermn_tpu.analysis.entrypoints import ENTRYPOINTS
+        from chainermn_tpu.observability.comm import get_accountant
+
+        ep = next(e for e in ENTRYPOINTS if e.name == "ops.collective.ring")
+        base = str(tmp_path / "trace.json")
+        tracer = obs.get_tracer()
+        acct = get_accountant()
+        was = obs.enabled()
+
+        static_bytes = None
+        rank_bytes = {}
+        try:
+            for rank in (0, 1):
+                tracer.reset()
+                acct.reset()
+                obs.enable()
+                spec = ep.build()          # fresh build: fresh compile
+                fn, args = spec["trace"]
+                fn(*args)
+                obs.export_chrome_trace(base, rank=rank)
+                rank_bytes[rank] = sum(
+                    row["bytes"] for row in acct.totals.values())
+                obs.disable()
+                if static_bytes is None:
+                    import jax
+                    jaxpr = jax.make_jaxpr(fn)(*args)
+                    static_bytes = sum(shardflow.group_bytes(
+                        shardflow.static_costs(jaxpr)).values())
+        finally:
+            tracer.reset()
+            acct.reset()
+            if was:
+                obs.enable()
+
+        merged = obs.merge_trace_shards(
+            base, out_path=str(tmp_path / "merged.json"))
+        assert merged["metadata"]["merged_ranks"] == [0, 1]
+
+        # last counter value per (pid, comm/<op>/bytes) = that rank's
+        # booked bytes for the op — they must survive re-homing
+        per_pid = {}
+        for ev in merged["traceEvents"]:
+            if ev.get("ph") == "C" and str(ev.get("name", "")).startswith(
+                    "comm/") and str(ev["name"]).endswith("/bytes"):
+                key = (ev["pid"], ev["name"])
+                per_pid[key] = list(ev["args"].values())[0]
+        for rank in (0, 1):
+            merged_rank_total = sum(v for (pid, _), v in per_pid.items()
+                                    if pid == rank)
+            assert merged_rank_total == rank_bytes[rank] > 0
+        assert static_bytes and sum(rank_bytes.values()) == \
+            static_bytes * 2
+
+
+# --------------------------------------------------------------------------
+# self-run: shipped registration clean modulo the checked-in baseline
+# --------------------------------------------------------------------------
+
+class TestSelfRun:
+    def test_clean_modulo_baseline_with_keepers(self, full_run):
+        findings, _ = full_run
+        baseline = load_baseline(BASELINE)
+        new, accepted = baseline.filter(findings)
+        assert new == [], "new shardflow findings:\n" + "\n".join(
+            f.render() for f in new)
+        assert len(accepted) >= 3  # the keepers are really there
+
+    def test_no_stale_baseline_entries(self, full_run):
+        findings, _ = full_run
+        baseline = load_baseline(BASELINE)
+        _, accepted = baseline.filter(findings)
+        hit = {f.fingerprint() for f in accepted}
+        stale = set(baseline.entries) - hit
+        assert not stale, (
+            f"baseline entries no longer observed (run "
+            f"scripts/shardflow_report.py --fix-baseline): "
+            f"{[baseline.entries[s]['path'] for s in stale]}")
+
+    def test_every_baseline_entry_has_comment(self):
+        baseline = load_baseline(BASELINE)
+        missing = [e["path"] for e in baseline.entries.values()
+                   if not e.get("comment")]
+        assert not missing
+
+    def test_deleting_baseline_entry_fails_the_gate(self, full_run):
+        findings, _ = full_run
+        baseline = load_baseline(BASELINE)
+        doomed = next(fp for fp, e in baseline.entries.items()
+                      if e["context"] == "x")
+        del baseline.entries[doomed]
+        new, _ = baseline.filter(findings)
+        assert len(new) == 1 and new[0].fingerprint() == doomed
+
+
+class TestRunnerCLI:
+    ENV = None
+
+    @classmethod
+    def setup_class(cls):
+        cls.ENV = {**os.environ, "JAX_PLATFORMS": "cpu"}
+        cls.SCRIPT = os.path.join(REPO, "scripts", "shardflow_report.py")
+
+    def test_unknown_entry_is_unusable(self):
+        r = subprocess.run(
+            [sys.executable, self.SCRIPT, "--entry", "no.such.entry"],
+            cwd=REPO, capture_output=True, text=True, env=self.ENV,
+            timeout=600)
+        assert r.returncode == 2
+        assert "unknown entry point" in r.stderr
+
+    def test_explicitly_naming_a_skipped_entry_is_unusable(self):
+        # review fix: a shardflow=False entry must not yield a silent
+        # "clean over 0 entry points" verdict when named explicitly
+        r = subprocess.run(
+            [sys.executable, self.SCRIPT, "--entry",
+             "serving.tick_with_tracing"],
+            cwd=REPO, capture_output=True, text=True, env=self.ENV,
+            timeout=600)
+        assert r.returncode == 2
+        assert "shardflow=False" in r.stderr
+
+    def test_list_entrypoints(self):
+        r = subprocess.run(
+            [sys.executable, self.SCRIPT, "--list-entrypoints"],
+            cwd=REPO, capture_output=True, text=True, env=self.ENV,
+            timeout=600)
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "train.step" in r.stdout
+        assert "ops.collective.ring" in r.stdout
+
+    @pytest.mark.slow
+    def test_exit_contract_and_json(self, tmp_path):
+        # 0 = clean against the shipped baseline (single entry: fast-ish)
+        r = subprocess.run(
+            [sys.executable, self.SCRIPT, "--entry", "train.demo_step",
+             "--json"],
+            cwd=REPO, capture_output=True, text=True, env=self.ENV,
+            timeout=600)
+        assert r.returncode == 0, r.stdout + r.stderr
+        doc = json.loads(r.stdout)
+        assert doc["schema"] == "chainermn_tpu.shardflow.v1"
+        assert doc["reports"][0]["reconciled"] is True
+
+        # 1 = findings without the baseline (the ring keeper)
+        r = subprocess.run(
+            [sys.executable, self.SCRIPT, "--entry", "ops.collective.ring",
+             "--no-baseline", "--json"],
+            cwd=REPO, capture_output=True, text=True, env=self.ENV,
+            timeout=600)
+        assert r.returncode == 1, r.stdout + r.stderr
+        doc = json.loads(r.stdout)
+        assert {f["rule"] for f in doc["findings"]} == \
+            {"unexpected-replication"}
+
+    @pytest.mark.slow
+    def test_partial_fix_baseline_carries_unselected_entries(
+            self, tmp_path):
+        # regenerating from ONE entry point must not wipe the decode-tick
+        # keepers (scoped regeneration, like lint_spmd's)
+        bl = tmp_path / "bl.json"
+        import shutil
+        shutil.copy(BASELINE, bl)
+        r = subprocess.run(
+            [sys.executable, self.SCRIPT, "--entry", "ops.collective.ring",
+             "--fix-baseline", "--baseline", str(bl)],
+            cwd=REPO, capture_output=True, text=True, env=self.ENV,
+            timeout=600)
+        assert r.returncode == 0, r.stdout + r.stderr
+        before = load_baseline(BASELINE)
+        after = load_baseline(str(bl))
+        assert set(after.entries) == set(before.entries)
+        for fp, e in after.entries.items():
+            assert e["comment"] == before.entries[fp]["comment"]

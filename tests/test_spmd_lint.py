@@ -44,6 +44,8 @@ AST_FIXTURE_DIRS = {
     "host-alias-race": "host_alias_race",
     "traced-control-flow": "traced_control_flow",
     "inplace-jit-mutation": "inplace_jit_mutation",
+    "mismatched-shard-specs": "mismatched_shard_specs",
+    "donated-buffer-reuse": "donated_buffer_reuse",
 }
 JAXPR_FIXTURE_DIRS = {
     "unbound-axis": "unbound_axis",
@@ -137,7 +139,8 @@ class TestRegistry:
         public = {n.name for n in tree.body
                   if isinstance(n, ast.FunctionDef)
                   and not n.name.startswith("_")}
-        expected = public - {"zeros_like_vma", "axis_index", "axis_size"}
+        expected = public - {"zeros_like_vma", "axis_index", "axis_size",
+                             "collective_wire_cost", "quantized_ring_cost"}
         assert expected == reg.ops_collectives
         assert "quantized_ring_pmean" in reg.ops_collectives
         assert "hierarchical_pmean" in reg.ops_collectives
@@ -302,6 +305,10 @@ class TestSelfRun:
         findings, reports = check_entrypoints()
         assert findings == [], [f.message for f in findings]
         by_name = {r.name: r for r in reports}
+        # the ISSUE 6 entry points trace cleanly too, with their
+        # collective surfaces visible
+        assert by_name["train.step"].collectives
+        assert by_name["train.demo_step"].collectives
         # the decode tick really is ONE program across value variants
         assert by_name["parallel.decode.lm_decode_tick"].n_compiles == 1
         # the prefill family really is per-length (and allowlisted)
@@ -430,6 +437,139 @@ class TestCLI:
                             "--rules", "no-such-rule", "chainermn_tpu"],
                            cwd=REPO, capture_output=True, text=True, env=env)
         assert r.returncode == 2
+
+    def test_entry_filter_runs_one_entrypoint(self):
+        # ISSUE 6 satellite: --entry restricts the jaxpr sweep to one
+        # registered entry point (fast single-subsystem iteration)
+        env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+        r = subprocess.run(
+            [sys.executable, "-m", "chainermn_tpu.analysis", "--json",
+             "--entry", "ops.collective.ring",
+             os.path.join("chainermn_tpu", "ops", "collective.py")],
+            cwd=REPO, capture_output=True, text=True, timeout=600, env=env)
+        assert r.returncode == 0, r.stdout + r.stderr
+        doc = json.loads(r.stdout)
+        assert [e["name"] for e in doc["entrypoints"]] == \
+            ["ops.collective.ring"]
+        assert doc["entrypoints"][0]["collectives"]
+
+    def test_entry_filter_unknown_name_is_unusable(self):
+        env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+        r = subprocess.run(
+            [sys.executable, "-m", "chainermn_tpu.analysis",
+             "--entry", "no.such.entry", "chainermn_tpu"],
+            cwd=REPO, capture_output=True, text=True, timeout=600, env=env)
+        assert r.returncode == 2
+        assert "unknown entry point" in r.stderr
+
+    def test_entry_filter_rejects_no_jaxpr(self):
+        env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+        script = os.path.join(REPO, "scripts", "lint_spmd.py")
+        r = subprocess.run(
+            [sys.executable, script, "--no-jaxpr", "--entry",
+             "ops.collective.ring", "chainermn_tpu"],
+            cwd=REPO, capture_output=True, text=True, env=env)
+        assert r.returncode == 2
+
+
+class TestNewRuleEdges:
+    """Targeted edges of the ISSUE 6 AST rules beyond the corpus."""
+
+    def test_donation_consumed_by_rebinding_tuple(self):
+        code = ("import jax\n"
+                "step = jax.jit(lambda p, s, b: (p, s),"
+                " donate_argnums=(0, 1))\n"
+                "def drive(params, opt, b):\n"
+                "    params, opt = step(params, opt, b)\n"
+                "    return params, opt\n")
+        assert analyze_source(code, "t.py") == []
+
+    def test_donation_read_in_later_statement_fires(self):
+        code = ("import jax\n"
+                "step = jax.jit(lambda p, b: p, donate_argnums=(0,))\n"
+                "def drive(params, b):\n"
+                "    out = step(params, b)\n"
+                "    return out, params\n")
+        fs = analyze_source(code, "t.py")
+        assert [f.rule for f in fs] == ["donated-buffer-reuse"]
+        assert fs[0].line == 5
+
+    def test_donation_in_one_branch_does_not_flag_the_other(self):
+        # review fix: donation state is branch-scoped — a jit-path-with-
+        # fallback shape must not FP, but a read AFTER the If still does
+        base = ("import jax\n"
+                "step = jax.jit(lambda p, b: p, donate_argnums=(0,))\n"
+                "def drive(params, b, cond):\n"
+                "    if cond:\n"
+                "        out = step(params, b)\n"
+                "    else:\n"
+                "        out = params.copy()\n"
+                "    return out\n")
+        assert analyze_source(base, "t.py") == []
+        after = base.replace("    return out\n", "    return out, params\n")
+        fs = analyze_source(after, "t.py")
+        assert [f.rule for f in fs] == ["donated-buffer-reuse"]
+
+    def test_terminating_donating_branch_does_not_leak_donation(self):
+        # review fix: `if fast: return step(params, b)` — control past
+        # the If can only come through the fallback path, so the read
+        # there must not flag; a NON-terminating donating branch still
+        # flags the read after the If
+        term = ("import jax\n"
+                "step = jax.jit(lambda p, b: p, donate_argnums=(0,))\n"
+                "def drive(params, b, fast):\n"
+                "    if fast:\n"
+                "        return step(params, b)\n"
+                "    return params.sum()\n")
+        assert analyze_source(term, "t.py") == []
+        live = term.replace("        return step(params, b)\n",
+                            "        out = step(params, b)\n")
+        fs = analyze_source(live, "t.py")
+        assert [f.rule for f in fs] == ["donated-buffer-reuse"]
+
+    def test_partial_jit_donate_form_is_tracked(self):
+        # review fix: partial(jax.jit, donate_argnums=...)(f) carries the
+        # kwarg on the INNER partial call — same hazard, same finding
+        code = ("import jax\n"
+                "from functools import partial\n"
+                "step = partial(jax.jit, donate_argnums=(0,))"
+                "(lambda p, b: p)\n"
+                "def drive(params, b):\n"
+                "    out = step(params, b)\n"
+                "    return out, params\n")
+        fs = analyze_source(code, "t.py")
+        assert [f.rule for f in fs] == ["donated-buffer-reuse"]
+
+    def test_donated_attribute_chain_tracked_and_rebindable(self):
+        # review fix: the advertised cache-pool shape (attribute buffer)
+        # really is tracked, and rebinding the base object clears it
+        bad = ("import jax\n"
+               "tick = jax.jit(lambda c, b: c, donate_argnums=(0,))\n"
+               "def drive(pool, b):\n"
+               "    out = tick(pool.caches, b)\n"
+               "    return out, pool.caches\n")
+        fs = analyze_source(bad, "t.py")
+        assert [f.rule for f in fs] == ["donated-buffer-reuse"]
+        assert "pool.caches" in fs[0].message
+        clean = ("import jax\n"
+                 "tick = jax.jit(lambda c, b: c, donate_argnums=(0,))\n"
+                 "def drive(pool, b, fresh):\n"
+                 "    out = tick(pool.caches, b)\n"
+                 "    pool = fresh()\n"
+                 "    return pool.caches\n")
+        assert analyze_source(clean, "t.py") == []
+
+    def test_shard_specs_silent_without_mesh_evidence(self):
+        # mesh comes from an opaque helper: the rule must not guess
+        code = ("from chainermn_tpu.ops.collective import psum\n"
+                "from jax import shard_map\n"
+                "from jax.sharding import PartitionSpec as P\n"
+                "def build(mesh):\n"
+                "    def body(v):\n"
+                "        return psum(v, 'model')\n"
+                "    return shard_map(body, mesh=mesh,"
+                " in_specs=(P(),), out_specs=P())\n")
+        assert analyze_source(code, "t.py") == []
 
     def test_rule_catalog_complete(self):
         assert set(AST_FIXTURE_DIRS) == set(AST_RULES)
