@@ -712,6 +712,99 @@ def bench_serving():
     return out
 
 
+def bench_serving_router():
+    """Serving FLEET perf (ISSUE 7): the same offered load pushed
+    through 1, 2, and 4 router-fronted replicas — TTFT p50/p99, fleet
+    tokens/s, occupancy, and the router's shed rate per point.
+
+    The workload is prefix-heavy (every prompt shares one system
+    prefix) so the radix-trie prefix cache and the router's
+    prefix-affinity dispatch are on the measured path; the offered load
+    (submit every fleet round) is sized beyond one replica's capacity,
+    so ``replicas_1`` sheds hard and the sweep shows shed rate falling
+    and fleet throughput rising with replica count.  Direction under
+    the regression gate: ``ttft*/shed*`` lower-is-better, throughput /
+    occupancy higher (scripts/check_perf_regression.py).
+    """
+    import jax
+    import numpy as np
+
+    import chainermn_tpu as mn
+    from chainermn_tpu.parallel import init_tp_transformer_lm
+    from chainermn_tpu.serving import AdmissionError, build_fleet
+
+    vocab, d_model, n_heads, n_layers = 128, 32, 4, 2
+    n_slots, n_requests, s_p, new = 2, 16, 8, 8
+    params = init_tp_transformer_lm(
+        jax.random.PRNGKey(0), vocab, d_model, n_heads, n_layers,
+        max_len=s_p + new, pos_impl="rope")
+    mesh = mn.make_nd_mesh(("model",), (1,), jax.devices()[:1])
+    rs = np.random.RandomState(0)
+    shared = rs.randint(0, vocab, s_p - 2)
+    prompts = [np.concatenate([shared, rs.randint(0, vocab, 2)])
+               .astype(np.int32) for _ in range(n_requests)]
+
+    def run_point(n_replicas):
+        router = build_fleet(
+            params, n_replicas, head_dim=d_model // n_heads,
+            n_slots=n_slots, max_total=s_p + new, mesh=mesh,
+            queue_capacity=4)
+        # warm every replica's compiles (prefill + tick + prefix copy)
+        # outside the measured window, then reset the stats clocks.
+        # TWO warm requests per replica: the first (a cold-cache miss)
+        # compiles prefill+tick and donates the shared prefix, the
+        # second HITS it and compiles the lazy copy_prefix program —
+        # otherwise the first measured hit pays that compile inside
+        # the gated ttft_p99 window
+        for rep in router.replicas:
+            for _ in range(2):
+                h = rep.submit(prompts[0], 2)
+                rep.engine.run(steps_budget=8)
+                assert h.status == "done", h.status
+            assert rep.engine.engine.prefix_copies >= 1, \
+                "warm-up failed to exercise the prefix-copy path"
+        router.run(steps_budget=50)
+        router.reset_stats()
+        nxt, steps, shed = 0, 0, 0
+        while nxt < n_requests or any(not rep.idle
+                                      for rep in router.replicas):
+            if nxt < n_requests:
+                try:
+                    router.submit(prompts[nxt], new)
+                except AdmissionError:
+                    shed += 1  # also counted in router/rejected_total
+                nxt += 1
+            router.step()
+            steps += 1
+            if steps > 40 * n_requests * new:  # safety valve
+                break
+        m = router.metrics()
+        router.close()
+        return {
+            "tokens_per_sec": round(m["router/fleet_tokens_per_sec"], 1),
+            "ttft_p50_ms": round(m.get("router/fleet_ttft_p50_ms", 0.0),
+                                 2),
+            "ttft_p99_ms": round(m.get("router/fleet_ttft_p99_ms", 0.0),
+                                 2),
+            "slot_occupancy_pct": round(
+                m["router/fleet_slot_occupancy_pct"], 1),
+            "shed_rate": round(m["router/shed_rate"], 4),
+            "rejected_queue_full": m["router/rejected/queue_full"],
+            "rejected_shed_slo": m["router/rejected/shed_slo"],
+            "affinity_dispatches": m["router/affinity_dispatches_total"],
+            "steps": steps,  # bookkeeping; the gate's _SKIP drops it
+        }
+
+    return {
+        "config": f"d{d_model} L{n_layers} h{n_heads} V{vocab} "
+                  f"slots{n_slots}/replica prompt{s_p} new{new} "
+                  f"x{n_requests} requests, shared {s_p - 2}-token prefix",
+        "replicas_1": run_point(1),
+        "replicas_2": run_point(2),
+        "replicas_4": run_point(4),
+    }
+
+
 def scaling_worker(n, grad_dtype=None, double_buffering=False):
     """Subprocess body: weak-scaling point on an n-device virtual CPU mesh.
 
@@ -1197,6 +1290,7 @@ def main():
         "transformer_lm_large": None,
         "decode": None,
         "serving": None,
+        "serving_router": None,
         "data_path": None,
         "long_context": None,
         "projected_scaling": projected,
@@ -1238,6 +1332,10 @@ def main():
                                   "tokens_per_sec"),
             "serving_ttft_p99_ms": g(result, "serving", "load_low",
                                      "ttft_p99_ms"),
+            "router_tps_r4": g(result, "serving_router", "replicas_4",
+                               "tokens_per_sec"),
+            "router_shed_r2": g(result, "serving_router", "replicas_2",
+                                "shed_rate"),
             "flash_s8192_mfu": g(result, "long_context",
                                  "flash_fwd_bwd_S8192", "attn_mfu"),
             "flash_s16384_mfu": g(result, "long_context",
@@ -1361,6 +1459,22 @@ def main():
             emit()
     else:
         print("bench: over budget — serving section skipped",
+              file=sys.stderr)
+
+    # --- serving fleet: router + prefix cache offered-load sweep -----------
+    # (ISSUE 7) Same every-backend contract as the serving section; the
+    # 1/2/4-replica sweep is the fleet trajectory's anchor and its
+    # ttft/shed keys gate direction-aware in bench_history.jsonl.
+    if not over_budget():
+        try:
+            result["serving_router"] = bench_serving_router()
+            emit("serving_router")
+        except Exception as e:
+            print(f"bench: serving_router section failed: {e!r}",
+                  file=sys.stderr)
+            emit()
+    else:
+        print("bench: over budget — serving_router section skipped",
               file=sys.stderr)
 
     # --- input pipeline: disk-fed vs synthetic -----------------------------

@@ -253,6 +253,128 @@ def _build_tick_with_tracing() -> Dict[str, Any]:
             "variants": (_TracedVariantProbe(jfn), variant_args)}
 
 
+class _RouterTeeProbe:
+    """Variant probe for the ROUTER-driven tick: every call runs under
+    the scoped tracer+tee state AND the router's per-request emissions
+    (dispatch complete-event, per-slot decode-tick complete-events with
+    trace ids) — the full fleet observability surface the replica tick
+    lives under in production (ISSUE 7)."""
+
+    def __init__(self, jfn):
+        self._jfn = jfn
+
+    def __call__(self, *a):
+        from chainermn_tpu import observability as obs
+        from chainermn_tpu.observability import flight
+        with _traced_obs_state():
+            t0 = obs.now_us()
+            obs.complete_event("router/dispatch", t0, 1,
+                               cat="serving_request",
+                               trace_id="req-analysis-rt00000000",
+                               replica="replica0", prefix_match_len=0)
+            with obs.span("serving/tick", cat="serving"):
+                out = self._jfn(*a)
+            obs.complete_event("request/decode_tick", t0,
+                               obs.now_us() - t0, cat="serving_request",
+                               trace_id="req-analysis-rt00000000",
+                               request=0, slot=0, active=1)
+            flight.note("router", event="dispatched",
+                        trace_id="req-analysis-rt00000000",
+                        replica="replica0")
+            flight.note("phase", name="serving/step")
+        return out
+
+    def _cache_size(self):
+        return self._jfn._cache_size()
+
+
+def _build_router_tick() -> Dict[str, Any]:
+    """The REPLICA decode tick as the serving router drives it (ISSUE
+    7): tracer enabled, flight tee installed, router dispatch +
+    per-request decode-tick complete-events emitted around the device
+    call.  Registered shardflow=True (unlike the plain tracing tee
+    variant) so the fleet path's collective bytes are INDEPENDENTLY
+    reconciled against the comm ledger — the router hop must add zero
+    device traffic and zero compiles: one program across variants."""
+    base = _build_decode_tick()
+    fn, args = base["trace"]
+    probe = _RouterTeeProbe(base["variants"][0])
+
+    def run_routed(*a):
+        return probe(*a)
+
+    return {"trace": (run_routed, args),
+            "bound_axes": base["bound_axes"],
+            "variants": (probe, base["variants"][1]),
+            "data_axis": "model",
+            "arg_labels": ("params", "tokens", "caches", "pos"),
+            "expected_replication": {
+                "params": "Megatron TP layout: matmul weights shard "
+                          "over 'model', norm scales/biases/embedding "
+                          "remainders replicate by design",
+                "caches": "KV pool rows are whole per replica at the "
+                          "registered cache specs (TP>1 shards heads "
+                          "inside the flat K/V rows)",
+                "pos": "per-slot position vector: 4 host-fed bytes "
+                       "copied to every TP rank each tick — the same "
+                       "replication the base decode-tick entry keeps "
+                       "as a baseline keeper",
+                # `tokens` deliberately UN-annotated: this entry's
+                # keeper finding (with comment) in the regenerated
+                # .shardflow-baseline.json proves the replication gate
+                # bites on the fleet path too
+            }}
+
+
+def _build_prefix_copy() -> Dict[str, Any]:
+    """The prefix cache's copy-on-extend program (ISSUE 7):
+    ``DecodeEngine.copy_prefix``'s slab copy over the REAL pool buffers
+    at tiny shapes.  The contract under analysis: pure data movement —
+    ZERO collectives (each TP rank copies its local columns; the comm
+    reconciliation holds it to an empty ledger) and ONE compiled
+    program across (src, dst) slot-index variants (the indices are
+    traced operands, never static — a recompile per pair would rebuild
+    the program on every cache hit)."""
+    import jax.numpy as jnp
+
+    from chainermn_tpu.serving.cache_pool import CachePool
+    from chainermn_tpu.serving.engine import DecodeEngine
+
+    params, specs, mesh = _tiny_lm()
+    head_dim = 4
+    n_kv = 2  # _tiny_lm: 2 heads, no GQA
+    pool = CachePool(2, 8, 1, n_kv * head_dim, params["embed"].dtype,
+                     mesh, "model")
+    eng = DecodeEngine(params, pool, mesh, "model", head_dim=head_dim)
+    jfn = eng._build_prefix_copy()
+    caches = pool.caches
+
+    def run(c, src, dst):
+        return jfn(c, src, dst)
+
+    variants = (jfn, [
+        (caches, jnp.int32(0), jnp.int32(1)),
+        (caches, jnp.int32(1), jnp.int32(0)),
+    ])
+    return {"trace": (run, (caches, jnp.int32(0), jnp.int32(1))),
+            "bound_axes": {"model"},
+            "variants": variants,
+            "data_axis": "model",
+            "arg_labels": ("caches", "src", "dst"),
+            # `caches` needs no annotation here: unlike the tick
+            # registrations' P() feeds, this entry threads the REAL
+            # pool buffers, sharded P(None, None, model) — the
+            # replication report sees them sharded, which is itself
+            # the regression signal (a future P() slip would flag)
+            "expected_replication": {
+                "src": "source slot index: one host-fed int32 scalar "
+                       "per copy, replicated to every TP rank by "
+                       "design",
+                "dst": "destination slot index: same 4-byte host-fed "
+                       "scalar as src",
+            }}
+
+
 def _build_flight_ring_program() -> Dict[str, Any]:
     """Flight-recorder entry point: the accounted collective ring run
     UNDER the ring tee (comm deltas -> flight events).  Guards the other
@@ -445,6 +567,21 @@ ENTRYPOINTS = [
         allow_recompile=True,
         description="per-prompt-length prefill programs (intentional "
                     "program family, see docs/SERVING.md)"),
+    EntryPoint(
+        name="serving.router_tick",
+        build=_build_router_tick,
+        description="replica decode tick under the ROUTER tee: tracer "
+                    "+ flight tee + router dispatch/per-request "
+                    "emissions — one program, zero extra device "
+                    "traffic, bytes reconciled independently of the "
+                    "base entry (ISSUE 7)"),
+    EntryPoint(
+        name="serving.prefix_copy",
+        build=_build_prefix_copy,
+        description="prefix-cache copy-on-extend slab copy "
+                    "(DecodeEngine.copy_prefix): zero collectives, one "
+                    "compiled program across (src, dst) slot variants "
+                    "(ISSUE 7)"),
     EntryPoint(
         name="serving.tick_with_tracing",
         build=_build_tick_with_tracing,

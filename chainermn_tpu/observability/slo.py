@@ -45,6 +45,23 @@ from . import trace
 from . import flight as _flight
 
 
+def percentile_of(values: List[float], q: float) -> Optional[float]:
+    """Linear-interpolated percentile (numpy's default definition) over
+    an UNSORTED value list, or None when empty — the one implementation
+    behind :meth:`ReservoirSample.percentile` and the router's fleet
+    TTFT merge (serving/router.py)."""
+    vals = sorted(values)
+    if not vals:
+        return None
+    if len(vals) == 1:
+        return vals[0]
+    pos = (len(vals) - 1) * (float(q) / 100.0)
+    lo = int(pos)
+    hi = min(lo + 1, len(vals) - 1)
+    frac = pos - lo
+    return vals[lo] * (1 - frac) + vals[hi] * frac
+
+
 class ReservoirSample:
     """Fixed-size uniform sample of an unbounded stream (algorithm R).
 
@@ -84,16 +101,7 @@ class ReservoirSample:
     def percentile(self, q: float) -> Optional[float]:
         """Linear-interpolated percentile over the retained sample (the
         same definition numpy uses), or None when empty."""
-        vals = sorted(self._values)
-        if not vals:
-            return None
-        if len(vals) == 1:
-            return vals[0]
-        pos = (len(vals) - 1) * (float(q) / 100.0)
-        lo = int(pos)
-        hi = min(lo + 1, len(vals) - 1)
-        frac = pos - lo
-        return vals[lo] * (1 - frac) + vals[hi] * frac
+        return percentile_of(self._values, q)
 
 
 class GoodputLedger:

@@ -17,9 +17,16 @@ kinds ``serving_step``/``serving_summary``) and ``--prom-out``
 Prometheus textfile — both the formats the observability layer already
 exports and ``scripts/check_perf_regression.py`` gates on.
 
+``--replicas N`` (ISSUE 7) stands up N engines behind the serving
+router instead: least-loaded prefix-affine dispatch, SLO-aware
+shedding, fleet-wide metrics/statusz — the summary then carries the
+``router/*`` keys (per-reason rejection counters included) and the
+JSONL stream gains ``router_rejection``/``router_summary`` records.
+
 Run:  python -m chainermn_tpu.serve --devices 8 --tp 2
       python -m chainermn_tpu.serve --steps-budget 40 --requests 8 \
           --metrics-out /tmp/serve.jsonl --prom-out /tmp/serve.prom
+      python -m chainermn_tpu.serve --replicas 2 --requests 12
 """
 
 import argparse
@@ -62,6 +69,11 @@ def main(argv=None):
                         help="PRNG seed for model init (spmd-lint: literal "
                              "PRNGKey seeds belong on the CLI, not in code)")
     parser.add_argument("--lr", type=float, default=1e-2)
+    parser.add_argument("--replicas", type=int, default=1,
+                        help="serving replicas behind the router (ISSUE "
+                             "7): N engines, least-loaded prefix-affine "
+                             "dispatch, SLO-aware shedding; 1 = the "
+                             "single-engine path")
     parser.add_argument("--n-slots", type=int, default=4)
     parser.add_argument("--max-total", type=int, default=None,
                         help="per-slot capacity (default: fits prompt + "
@@ -175,16 +187,28 @@ def main(argv=None):
         from chainermn_tpu.observability.slo import SLOTracker
         slo = SLOTracker(ttft_target_ms=args.ttft_slo_ms,
                          tokens_per_sec_target=args.tps_slo)
-    eng = ServingEngine(
-        trained, head_dim=head_dim, n_slots=args.n_slots,
+    eng_kwargs = dict(
+        head_dim=head_dim, n_slots=args.n_slots,
         max_total=args.max_total or max(total_len, 8),
-        mesh=serve_mesh, queue_capacity=args.queue_capacity,
-        metrics_writer=writer, slo=slo)
+        mesh=serve_mesh, queue_capacity=args.queue_capacity)
+    router = None
+    if args.replicas > 1:
+        from chainermn_tpu.serving import build_fleet
+        # the fleet shares ONE SLO tracker (all replicas burn one
+        # budget) and the router owns the JSONL writer (router_rejection
+        # + router_summary records ride the serving stream)
+        router = build_fleet(trained, args.replicas, slo=slo,
+                             metrics_writer=writer, **eng_kwargs)
+        eng = None
+    else:
+        eng = ServingEngine(trained, metrics_writer=writer, slo=slo,
+                            **eng_kwargs)
+    service = router if router is not None else eng
     statusz = None
     if args.statusz_port is not None:
         statusz = obs.start_status_server(
-            args.statusz_port, extra_gauges=eng.metrics,
-            requests_fn=eng.requests_table,
+            args.statusz_port, extra_gauges=service.metrics,
+            requests_fn=service.requests_table,
             dump_dir=args.flight_dump_dir)
 
     test = make_corpus(np.random.RandomState(99), args.requests,
@@ -200,11 +224,17 @@ def main(argv=None):
 
     def submit(i):
         try:
-            handles[i] = eng.submit(prompts[i], args.max_new_tokens,
-                                    on_token=stream)
+            handles[i] = service.submit(prompts[i], args.max_new_tokens,
+                                        on_token=stream)
         except AdmissionError as e:
-            rejected[i] = e.reason
+            rejected[i] = e.to_dict()
             print(f"request {i} rejected: {e}", file=sys.stderr)
+
+    def service_busy():
+        if router is not None:
+            return any(not rep.idle for rep in router.replicas)
+        return (eng.scheduler.queue_depth > 0
+                or eng.pool.busy_count > 0)
 
     for i in range(first_wave):
         submit(i)
@@ -215,10 +245,11 @@ def main(argv=None):
     def can_step():
         return budget is None or steps < budget
 
-    while can_step() and (nxt < args.requests
-                          or eng.scheduler.queue_depth > 0
-                          or eng.pool.busy_count > 0):
-        eng.step()
+    while can_step() and (nxt < args.requests or service_busy()):
+        if router is not None:
+            router.step()
+        else:
+            eng.step()
         steps += 1
         if nxt < args.requests and steps % max(args.stagger_every, 1) == 0:
             submit(nxt)
@@ -229,8 +260,8 @@ def main(argv=None):
     correct = []
     for i in range(args.requests):
         if i in rejected:
-            per_request.append({"id": i, "status": "rejected",
-                                "reason": rejected[i]})
+            per_request.append(dict({"id": i, "status": "rejected"},
+                                    **rejected[i]))
             continue
         h = handles.get(i)
         if h is None:
@@ -250,21 +281,31 @@ def main(argv=None):
         print(f"prompt {prompts[i].tolist()} -> {toks} "
               f"(true continuation {want[i].tolist()})", file=sys.stderr)
 
-    metrics = eng.metrics()
-    goodput = eng.goodput.report()
+    metrics = service.metrics()
+    if router is not None:
+        # per-replica wall-clock partitions (each replica's ledger is
+        # its own 5%-reconciled partition; summing them double-counts)
+        goodput = {rep.name: rep.engine.goodput.report()
+                   for rep in router.replicas}
+    else:
+        goodput = eng.goodput.report()
     if writer is not None:
-        eng.finalize_metrics()
+        if router is not None:
+            router.finalize_metrics()
+        else:
+            eng.finalize_metrics()
         writer.close()
     if args.prom_out:
-        eng.write_prometheus(args.prom_out)
+        service.write_prometheus(args.prom_out)
     if args.trace_out:
         obs.export_chrome_trace(args.trace_out)
     if statusz is not None:
         statusz.stop()
-    eng.close()
+    service.close()
     summary = {
         "schema": "chainermn_tpu.serve.v1",
         "engine_steps": steps,
+        "replicas": args.replicas,
         "requests": per_request,
         "mean_continuation_accuracy": (
             round(float(np.mean(correct)), 3) if correct else None),

@@ -30,8 +30,21 @@ Layers (host → device):
   timestamps/spans, serving gauges through the tracer and the
   Prometheus/JSONL exporters).
 
+Fleet layer (ISSUE 7; docs/SERVING.md "Router, prefix cache &
+admission"):
+
+* :mod:`~chainermn_tpu.serving.prefix_cache` — radix-trie prefix cache
+  over donated read-only KV slots (refcounted; LRU-scavenged), so
+  shared system prompts skip re-prefill via the engine's compiled
+  copy-on-extend path.
+* :mod:`~chainermn_tpu.serving.replica` / :mod:`~chainermn_tpu.serving
+  .router` — N engines behind one :class:`ServingRouter`: least-loaded
+  deadline-aware prefix-affine dispatch, SLO-burn-driven shedding with
+  machine-readable rejections, fleet-wide metrics//statusz roll-up.
+
 ``python -m chainermn_tpu.serve`` is the CLI demo over the toy-corpus
-LM from ``examples/generate``.  See docs/SERVING.md.
+LM from ``examples/generate`` (``--replicas N`` stands up the fleet).
+See docs/SERVING.md.
 """
 
 from .scheduler import (  # noqa: F401
@@ -40,14 +53,18 @@ from .scheduler import (  # noqa: F401
     Scheduler,
 )
 from .cache_pool import SlotAllocator  # noqa: F401
+from .prefix_cache import PrefixCache, PrefixEntry  # noqa: F401
 
 __all__ = ["AdmissionError", "Request", "Scheduler", "SlotAllocator",
-           "ServingEngine", "RequestHandle", "CachePool", "DecodeEngine"]
+           "PrefixCache", "PrefixEntry",
+           "ServingEngine", "RequestHandle", "CachePool", "DecodeEngine",
+           "Replica", "ServingRouter", "build_fleet"]
 
 
 def __getattr__(name):
     # The device-side halves import jax; keep `import chainermn_tpu.serving`
-    # cheap for host-only consumers (the scheduler fuzz tests).
+    # cheap for host-only consumers (the scheduler + prefix-trie fuzz
+    # tests).
     if name in ("ServingEngine", "RequestHandle"):
         from . import frontend
         return getattr(frontend, name)
@@ -57,4 +74,10 @@ def __getattr__(name):
     if name == "DecodeEngine":
         from .engine import DecodeEngine
         return DecodeEngine
+    if name == "Replica":
+        from .replica import Replica
+        return Replica
+    if name in ("ServingRouter", "build_fleet"):
+        from . import router
+        return getattr(router, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -23,19 +23,32 @@ cross-talk fuzz in tests/test_serving.py.
 
 :class:`SlotAllocator` is the jax-free bookkeeping half (fuzzable
 standalone); :class:`CachePool` adds the device buffers.
+
+Prefix-cache extension (ISSUE 7): a slot now has THREE states, not two
+— ``free`` (on the free list), ``busy`` (a live request's K/V), and
+``cached`` (a finished request's prompt K/V donated to the radix-trie
+prefix cache as a READ-ONLY shared prefix, with a refcount of the
+in-flight requests currently built on it).  Cached slots are
+*scavengeable* capacity: admission treats an rc==0 cached slot as
+free-after-eviction, so the prefix cache can never starve decoding —
+it only borrows slots nobody needs yet.  Refcounts are the allocator's
+(hard-error) invariants for the same reason double-release is: a leaked
+ref pins a slot forever, silently shrinking the pool.
 """
 
 from __future__ import annotations
 
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 
 class SlotAllocator:
-    """Free-list slot bookkeeping: acquire → occupied, release → recycled.
+    """Free/busy/cached slot bookkeeping: acquire → busy, release →
+    recycled, cache → read-only prefix slot (refcounted) until evicted.
 
     Slots are handed out lowest-index-first (deterministic for tests);
-    double-release and foreign releases raise — a slot leak in a serving
-    loop is silent capacity loss, so the invariants are hard errors.
+    double-release, foreign releases, and refcount underflow raise — a
+    slot leak in a serving loop is silent capacity loss, so the
+    invariants are hard errors.
     """
 
     def __init__(self, n_slots: int):
@@ -44,6 +57,7 @@ class SlotAllocator:
         self.n_slots = int(n_slots)
         self._free: List[int] = list(range(self.n_slots))
         self._busy: set = set()
+        self._cached: Dict[int, int] = {}   # slot -> refcount
 
     def acquire(self) -> Optional[int]:
         """Lowest free slot index, or None when the pool is saturated."""
@@ -62,6 +76,51 @@ class SlotAllocator:
         self._free.append(slot)
         self._free.sort()
 
+    # ---- prefix-cache faces: busy -> cached(rc) -> free ----
+    def cache(self, slot: int) -> None:
+        """Donate a busy slot to the prefix cache (read-only, rc=0)."""
+        if slot not in self._busy:
+            raise ValueError(f"slot {slot} is not busy (only a live "
+                             f"request's slot can be donated); "
+                             f"busy={sorted(self._busy)}")
+        self._busy.remove(slot)
+        self._cached[slot] = 0
+
+    def retain(self, slot: int) -> int:
+        """Pin a cached slot for one more in-flight reader."""
+        if slot not in self._cached:
+            raise ValueError(f"slot {slot} is not cached; "
+                             f"cached={sorted(self._cached)}")
+        self._cached[slot] += 1
+        return self._cached[slot]
+
+    def unretain(self, slot: int) -> int:
+        if slot not in self._cached:
+            raise ValueError(f"slot {slot} is not cached; "
+                             f"cached={sorted(self._cached)}")
+        if self._cached[slot] <= 0:
+            raise ValueError(f"slot {slot} refcount underflow (double "
+                             f"unretain)")
+        self._cached[slot] -= 1
+        return self._cached[slot]
+
+    def uncache(self, slot: int) -> None:
+        """Evict a cached slot back to the free list (rc must be 0: an
+        entry someone is still built on must never be recycled)."""
+        rc = self._cached.get(slot)
+        if rc is None:
+            raise ValueError(f"slot {slot} is not cached; "
+                             f"cached={sorted(self._cached)}")
+        if rc != 0:
+            raise ValueError(f"slot {slot} still has {rc} reader(s); "
+                             f"refusing to evict a pinned prefix")
+        del self._cached[slot]
+        self._free.append(slot)
+        self._free.sort()
+
+    def refcount(self, slot: int) -> Optional[int]:
+        return self._cached.get(slot)
+
     @property
     def free_count(self) -> int:
         return len(self._free)
@@ -70,11 +129,21 @@ class SlotAllocator:
     def busy_count(self) -> int:
         return len(self._busy)
 
+    @property
+    def cached_count(self) -> int:
+        return len(self._cached)
+
     def check_invariants(self) -> None:
-        """No leak, no alias: free ∪ busy is exactly {0..n_slots-1}."""
+        """No leak, no alias: free ∪ busy ∪ cached is exactly
+        {0..n_slots-1}, pairwise disjoint, and every refcount >= 0."""
         free, busy = set(self._free), set(self._busy)
+        cached = set(self._cached)
         assert not (free & busy), (free, busy)
-        assert free | busy == set(range(self.n_slots)), (free, busy)
+        assert not (free & cached), (free, cached)
+        assert not (busy & cached), (busy, cached)
+        assert free | busy | cached == set(range(self.n_slots)), \
+            (free, busy, cached)
+        assert all(rc >= 0 for rc in self._cached.values()), self._cached
 
 
 class CachePool:
@@ -129,6 +198,25 @@ class CachePool:
         self.pos[slot] = 0
         self.allocator.release(slot)
 
+    # prefix-cache faces.  A cached slot's ``pos`` is deliberately NOT
+    # reset: the tick still advances every slot's position, so the
+    # cached slot's garbage writes keep landing at its drifting pos —
+    # strictly ABOVE the donated prefix length — leaving the read-only
+    # rows [0, length) intact for the copy-on-extend path (the same
+    # above-``pos`` unreachability argument as free-slot recycling).
+    def cache(self, slot: int) -> None:
+        self.allocator.cache(slot)
+
+    def uncache(self, slot: int) -> None:
+        self.pos[slot] = 0
+        self.allocator.uncache(slot)
+
+    def retain(self, slot: int) -> int:
+        return self.allocator.retain(slot)
+
+    def unretain(self, slot: int) -> int:
+        return self.allocator.unretain(slot)
+
     @property
     def free_count(self) -> int:
         return self.allocator.free_count
@@ -136,3 +224,7 @@ class CachePool:
     @property
     def busy_count(self) -> int:
         return self.allocator.busy_count
+
+    @property
+    def cached_count(self) -> int:
+        return self.allocator.cached_count

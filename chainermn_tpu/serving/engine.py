@@ -88,12 +88,14 @@ class DecodeEngine:
                              for _ in range(pool.n_layers)]
         self._prefill_progs = {}   # padded prompt length -> compiled fn
         self._tick_prog = self._build_tick()
+        self._prefix_copy_prog = None   # built lazily on first hit
         # program/compile accounting (flight bundles + /statusz report
         # these: a growing prefill-family or a tick_calls≈compile count
         # mismatch is the recompile postmortem signal)
         self.prefill_compiles = 0
         self.prefill_calls = 0
         self.tick_calls = 0
+        self.prefix_copies = 0
 
     # ---- program builders ----
     def _build_tick(self):
@@ -147,6 +149,36 @@ class DecodeEngine:
             in_specs=(self._specs, self._cache_specs, P(), P(), P()),
             out_specs=(P(), self._cache_specs)))
 
+    def _build_prefix_copy(self):
+        """Slot-to-slot K/V slab copy — the prefix cache's copy-on-
+        extend device half (ISSUE 7).  Copies the ENTIRE src slot row
+        into dst for every layer: rows beyond the matched prefix length
+        carry stale K/V, but they are unreachable by the standard
+        above-``pos`` masking argument and the next occupant's writes
+        land below its own pos first — so the program needs no length
+        operand and compiles ONCE for the pool's lifetime (src/dst are
+        tiny traced scalars, never static)."""
+        import jax
+
+        def copy_inner(caches, src, dst):
+            new_caches = []
+            for kc, vc in caches:
+                k_row = jax.lax.dynamic_index_in_dim(kc, src, axis=0,
+                                                     keepdims=True)
+                v_row = jax.lax.dynamic_index_in_dim(vc, src, axis=0,
+                                                     keepdims=True)
+                start = (dst, 0, 0)
+                new_caches.append(
+                    (jax.lax.dynamic_update_slice(kc, k_row, start),
+                     jax.lax.dynamic_update_slice(vc, v_row, start)))
+            return new_caches
+
+        P = self._P
+        return jax.jit(self._shard_map(
+            copy_inner, mesh=self.mesh,
+            in_specs=(self._cache_specs, P(), P()),
+            out_specs=self._cache_specs))
+
     # ---- serving faces (host-driven, one call per engine iteration) ----
     def padded_len(self, s_real: int) -> int:
         b = self.prefill_bucket
@@ -186,6 +218,32 @@ class DecodeEngine:
             jnp.int32(s_real), jnp.int32(slot))
         self.pool.pos[slot] = s_real
         return int(np.asarray(tok)[0])
+
+    def copy_prefix(self, src_slot: int, dst_slot: int,
+                    prefix_len: int) -> None:
+        """Copy-on-extend entry: clone ``src_slot``'s K/V slab into
+        ``dst_slot`` and set ``pool.pos[dst_slot] = prefix_len`` so the
+        occupant's next write lands at the first un-cached position.
+        The source slot is READ-ONLY shared state (refcounted by the
+        prefix cache); jax arrays are immutable, so the 'copy' is a
+        functional update producing new pool caches — the cached rows
+        can never be corrupted by the reader.  One compiled program for
+        the pool's lifetime (asserted by the ``serving.prefix_copy``
+        analysis entry point)."""
+        import jax.numpy as jnp
+
+        if not (0 < int(prefix_len) <= self.pool.max_total):
+            raise ValueError(
+                f"prefix_len {prefix_len} out of range (0, "
+                f"{self.pool.max_total}]")
+        if self._prefix_copy_prog is None:
+            self._prefix_copy_prog = self._build_prefix_copy()
+            from ..observability import flight as _flight
+            _flight.note("compile", program="serving_prefix_copy")
+        self.prefix_copies += 1
+        self.pool.caches = self._prefix_copy_prog(
+            self.pool.caches, jnp.int32(src_slot), jnp.int32(dst_slot))
+        self.pool.pos[dst_slot] = int(prefix_len)
 
     def tick(self, last_tokens: np.ndarray) -> np.ndarray:
         """One decode tick for ALL slots: consume ``last_tokens

@@ -68,6 +68,7 @@ from ..observability import flight as _flight
 from ..observability.slo import GoodputLedger, ReservoirSample, SLOTracker
 from .cache_pool import CachePool
 from .engine import DecodeEngine
+from .prefix_cache import PrefixCache
 from .scheduler import AdmissionError, Request, Scheduler
 
 
@@ -150,7 +151,9 @@ class ServingEngine:
                  prefill_bucket: int = 1, metrics_writer=None,
                  stats_capacity: int = 1024,
                  slo: Optional[SLOTracker] = None,
-                 recent_capacity: int = 64):
+                 recent_capacity: int = 64,
+                 prefix_cache: bool = True,
+                 min_prefix_len: int = 2):
         from ..parallel.decode import _kv_heads
 
         n_kv = _kv_heads(params, head_dim)
@@ -169,6 +172,16 @@ class ServingEngine:
             queue_capacity, max_total,
             max_prefills_per_tick=max_prefills_per_tick,
             max_positions=self.engine.max_positions)
+        # radix-trie prefix cache (ISSUE 7): finished requests donate
+        # their slot (busy -> cached, read-only, refcounted); admission
+        # scavenges rc==0 entries LRU-first when the free list is empty
+        self.prefix_cache: Optional[PrefixCache] = None
+        if prefix_cache:
+            self.prefix_cache = PrefixCache(
+                retain_slot=self.pool.retain,
+                release_slot=self.pool.unretain,
+                evict_slot=self.pool.uncache,
+                min_prefix_len=min_prefix_len)
         self.metrics_writer = metrics_writer
         self._running: Dict[int, Request] = {}   # slot -> request
         self._lock = threading.Lock()            # guards _running + stats
@@ -206,19 +219,21 @@ class ServingEngine:
     def submit(self, prompt, max_new_tokens: int, *,
                eos_id: Optional[int] = None,
                deadline_s: Optional[float] = None,
-               on_token: Optional[Callable[[int, int], None]] = None
-               ) -> RequestHandle:
+               on_token: Optional[Callable[[int, int], None]] = None,
+               trace_id: Optional[str] = None) -> RequestHandle:
         """Enqueue a generation request; raises :class:`AdmissionError`
         (with ``.reason``) when the queue is full or it can never fit.
         ``on_token(token, request_id)`` streams each token from the
         driver thread as it is emitted; ``deadline_s`` is relative to
-        now."""
+        now.  ``trace_id`` lets an upstream hop (the serving router)
+        mint the distributed trace identity so its spans and the
+        engine's merge into one Perfetto lane."""
         now = time.monotonic()
         prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
         req = Request(prompt, max_new_tokens, eos_id=eos_id,
                       deadline_t=(now + deadline_s
                                   if deadline_s is not None else None),
-                      on_token=on_token)
+                      on_token=on_token, trace_id=trace_id)
         # tracer-clock stamp + flow BEGIN before the request becomes
         # visible to the scheduler: with start()'s driver thread, a
         # request can be admitted (even finished) the instant submit()
@@ -273,8 +288,14 @@ class ServingEngine:
         around them as ``host``, and the gap since the previous step as
         ``queue_wait`` (work was waiting) or ``stall`` (idle)."""
         t_step0 = time.monotonic()
-        if self._last_step_end is not None:
-            gap = t_step0 - self._last_step_end
+        # the gap since the previous step — or, on the FIRST step, since
+        # construction/reset: a fleet replica can idle a long time while
+        # a sibling compiles, and leaving that window unattributed would
+        # swamp its ledger's coverage (ISSUE 7)
+        last = (self._last_step_end if self._last_step_end is not None
+                else self._t0)
+        gap = t_step0 - last
+        if gap > 0:
             had_work = (self.scheduler.queue_depth > 0
                         or self.pool.busy_count > 0)
             self.goodput.add("queue_wait" if had_work else "stall", gap)
@@ -286,10 +307,49 @@ class ServingEngine:
                         request=req.id, trace_id=req.trace_id)
             self._finish_tracing(req, "deadline")
 
-        # admit up to the interleave bound into free slots
-        for req in self.scheduler.admissions(self.pool.free_count, now):
-            slot = self.pool.acquire()
-            assert slot is not None  # admissions() is bounded by free_count
+        # admit up to the interleave bound into free slots; rc==0 cached
+        # prefix slots count as free-after-eviction (scavengeable)
+        avail = self.pool.free_count
+        if self.prefix_cache is not None:
+            avail += self.prefix_cache.evictable_count()
+        admitted_batch = self.scheduler.admissions(avail, now)
+        for batch_i, req in enumerate(admitted_batch):
+            # match-and-PIN the radix trie BEFORE taking a slot: the
+            # acquire below may scavenge an rc==0 cached slot, and an
+            # unpinned match would be its own eviction victim — under a
+            # saturated pool every donation would be scavenged by the
+            # next admission and the cache could never produce a hit
+            entry = None
+            mlen = 0
+            if self.prefix_cache is not None:
+                entry, mlen = self.prefix_cache.match(req.prompt)
+                if entry is not None:
+                    self.prefix_cache.retain(entry)
+                    req.prefix_entry, req.prefix_len = entry, mlen
+            slot = self._acquire_slot()
+            if slot is None and entry is not None:
+                # OUR OWN match is the only scavengeable slot: with no
+                # busy slots nothing else will ever free one, so give
+                # up the hit rather than stall the pool — unpin and
+                # scavenge it like any other cold entry (and back the
+                # counters out: this became a miss)
+                self.prefix_cache.release(entry)
+                self.prefix_cache.hits -= 1
+                self.prefix_cache.misses += 1
+                self.prefix_cache.tokens_reused -= mlen
+                req.prefix_entry, req.prefix_len = None, 0
+                entry, mlen = None, 0
+                slot = self._acquire_slot()
+            if slot is None:
+                # every scavengeable slot is pinned by EARLIER
+                # admissions in this batch — put THIS request AND every
+                # later one admissions() already popped back at the
+                # queue head (reverse order keeps FIFO; dropping them
+                # would strand their handles un-done forever); a
+                # finishing request unblocks the next step
+                for later in reversed(admitted_batch[batch_i:]):
+                    self.scheduler.requeue_front(later)
+                break
             req.slot = slot
             req.status = "running"
             t_admit = time.monotonic()
@@ -306,6 +366,50 @@ class ServingEngine:
                         request=req.id, slot=slot, trace_id=req.trace_id)
             _flight.note("serving", event="admitted", request=req.id,
                          trace_id=req.trace_id, slot=slot)
+            # prefix HIT (matched above): copy the cached slot's K/V
+            # instead of re-prefilling the shared prefix; the un-cached
+            # suffix feeds through the shared decode tick one token per
+            # iteration (``req.forced``)
+            if entry is not None:
+                req.forced.extend(req.prompt[mlen:])
+                self.goodput.add("host", t_admit - t_host)
+                t_cp = time.monotonic()
+                try:
+                    with obs.span("serving/prefix_copy",
+                                  cat="serving_request", request=req.id,
+                                  trace_id=req.trace_id, slot=slot,
+                                  src_slot=entry.slot, prefix_len=mlen):
+                        self.engine.copy_prefix(entry.slot, slot, mlen)
+                    t_host = time.monotonic()
+                    self.goodput.add("compute", t_host - t_cp)
+                except Exception as e:
+                    t_host = time.monotonic()
+                    self.goodput.add("compute", t_host - t_cp)
+                    self._abort_slot(req, slot)
+                    req.finish("error", time.monotonic())
+                    obs.instant("serving/request/error", cat="serving",
+                                request=req.id, trace_id=req.trace_id)
+                    _flight.note("serving", event="error",
+                                 request=req.id, trace_id=req.trace_id,
+                                 error=repr(e))
+                    self._finish_tracing(req, "error")
+                    print(f"chainermn_tpu.serving: prefix copy for "
+                          f"request {req.id} failed: {e!r}",
+                          file=sys.stderr)
+                    continue
+                obs.instant("serving/request/prefix_hit", cat="serving",
+                            request=req.id, slot=slot,
+                            trace_id=req.trace_id, prefix_len=mlen,
+                            src_slot=entry.slot)
+                _flight.note("serving", event="prefix_hit",
+                             request=req.id, trace_id=req.trace_id,
+                             slot=slot, prefix_len=mlen)
+                with self._lock:
+                    self._running[slot] = req
+                # no token yet: the suffix's LAST tick emits the first
+                # one; only the deadline can evict before that
+                self._maybe_evict(req, time.monotonic())
+                continue
             try:
                 self.goodput.add("host", t_admit - t_host)
                 compiles_before = self.engine.prefill_compiles
@@ -329,7 +433,7 @@ class ServingEngine:
                 # — with start() an escaping exception would kill the
                 # background thread and stall every other request, so the
                 # engine sheds the request and keeps serving
-                self.pool.release(slot)
+                self._abort_slot(req, slot)
                 req.finish("error", time.monotonic())
                 obs.instant("serving/request/error", cat="serving",
                             request=req.id, trace_id=req.trace_id)
@@ -350,7 +454,11 @@ class ServingEngine:
         if active:
             tokens = np.zeros(self.pool.n_slots, np.int32)
             for slot, req in active.items():
-                tokens[slot] = req.tokens[-1]
+                # a prefix-hit request still owing suffix tokens feeds
+                # the next PROMPT token (its K/V row gets written; the
+                # prediction is known and discarded until the last one)
+                tokens[slot] = (req.forced[0] if req.forced
+                                else req.tokens[-1])
             t_tick = time.monotonic()
             self.goodput.add("host", t_tick - t_host)
             tick_bucket = ("compile" if self.engine.tick_calls == 0
@@ -371,7 +479,14 @@ class ServingEngine:
                     "request/decode_tick", t_tick_us, dt_us,
                     cat="serving_request", trace_id=req.trace_id,
                     request=req.id, slot=slot, active=len(active))
-                self._emit(req, int(nxt[slot]), now)
+                still_forced = False
+                if req.forced:
+                    req.forced.popleft()
+                    still_forced = bool(req.forced)
+                if not still_forced:
+                    # miss path, or the suffix's last prompt token just
+                    # ran: the tick's prediction IS the next real token
+                    self._emit(req, int(nxt[slot]), now)
                 self._tok_lat_ms.add(dt_ms / max(len(active), 1))
                 self._maybe_evict(req, now)
 
@@ -452,10 +567,48 @@ class ServingEngine:
         req.finish(reason, now)
         with self._lock:
             self._running.pop(slot, None)
-        self.pool.release(slot)
+        self._retire_slot(req, slot)
         obs.instant("serving/request/complete", cat="serving",
                     request=req.id, reason=reason, trace_id=req.trace_id)
         self._finish_tracing(req, reason)
+
+    # ---- slot lifecycle (prefix-cache aware; ISSUE 7) ----
+    def _acquire_slot(self) -> Optional[int]:
+        """Free slot, scavenging the LRU unpinned prefix entry when the
+        free list is empty — the cache borrows capacity, never owns it."""
+        slot = self.pool.acquire()
+        if slot is None and self.prefix_cache is not None:
+            if self.prefix_cache.evict_lru() is not None:
+                slot = self.pool.acquire()
+        return slot
+
+    def _abort_slot(self, req: Request, slot: int) -> None:
+        """Failed admission: unpin the request's prefix source (if any)
+        and return the slot to the free list — never donate K/V that
+        was only partially written."""
+        if req.prefix_entry is not None and self.prefix_cache is not None:
+            self.prefix_cache.release(req.prefix_entry)
+            req.prefix_entry = None
+        self.pool.release(slot)
+
+    def _retire_slot(self, req: Request, slot: int) -> None:
+        """Finished request: unpin its prefix source, then DONATE the
+        slot to the prefix cache (busy → cached, rc=0) keyed by every
+        K/V row actually written — ``prompt + generated[:-1]`` clipped
+        to the slot's position — falling back to a plain release when
+        the cache dedups the donation or is disabled."""
+        cache = self.prefix_cache
+        if req.prefix_entry is not None and cache is not None:
+            cache.release(req.prefix_entry)
+            req.prefix_entry = None
+        if cache is not None:
+            length = int(self.pool.pos[slot])
+            seq = list(req.prompt) + list(req.tokens[:-1])
+            if length >= cache.min_prefix_len \
+                    and cache.insert(seq[:length], slot, length) is not None:
+                self.pool.cache(slot)
+                return
+        self.pool.release(slot)
 
     # ---- driving ----
     def run(self, steps_budget: Optional[int] = None,
@@ -528,6 +681,13 @@ class ServingEngine:
             self.goodput.reset()
             self._last_step_end = None
             self._slo_last = (0, self._t0)
+            if self.prefix_cache is not None:
+                # zero the cumulative counters; entries/pins stay (the
+                # warm cache IS the steady state bench measures)
+                pc = self.prefix_cache
+                pc.hits = pc.misses = pc.tokens_reused = 0
+                pc.insertions = pc.rejected_insertions = 0
+                pc.evictions = 0
 
     def metrics(self) -> Dict[str, float]:
         """Host-side serving summary (the Prometheus ``extra_gauges`` /
@@ -553,6 +713,11 @@ class ServingEngine:
                 if p50 is not None:
                     out[f"serving/{name}_p50_ms"] = p50
                     out[f"serving/{name}_p99_ms"] = p99
+        if self.prefix_cache is not None:
+            for k, v in self.prefix_cache.stats().items():
+                out[f"serving/prefix/{k}"] = v
+            out["serving/prefix/cached_slots"] = float(
+                self.pool.cached_count)
         out.update(self.goodput.gauges("serving/goodput"))
         return out
 
@@ -587,9 +752,15 @@ class ServingEngine:
             "rejected": self._rejected,
             "prefill_compiles": self.engine.prefill_compiles,
             "tick_calls": self.engine.tick_calls,
+            "prefix_copies": self.engine.prefix_copies,
             "goodput": self.goodput.report(),
             "requests": self.requests_table(),
         }
+        if self.prefix_cache is not None:
+            state["prefix_cache"] = dict(
+                self.prefix_cache.stats(),
+                cached_slots=self.pool.cached_count,
+                total_refcount=self.prefix_cache.total_refcount())
         if self.slo is not None:
             state["slo"] = self.slo.status()
         return state

@@ -1,0 +1,369 @@
+"""Serving fleet router: dispatch, prefix affinity, SLO admission.
+
+PR 3's :class:`~chainermn_tpu.serving.frontend.ServingEngine` is one
+pool on one mesh; "millions of users" (ROADMAP item 3) needs the layer
+above — the ChainerMN hierarchy lesson applied to serving: N engines as
+the fast lane, this host-side router as the slow lane composing them
+into ONE service.  Three policies, each deliberately inspectable:
+
+**Dispatch** (least-loaded, deadline-aware, prefix-affine).  Every
+candidate replica is scored in TOKEN units::
+
+    score = prefix_match_len − backlog_tokens
+
+``prefix_match_len`` (the replica's radix-trie peek) is compute the
+replica does NOT have to do; ``backlog_tokens`` (queued prompt+decode
+work plus running remainders) is compute it must do first.  One
+currency, so affinity and load balance trade off without magic weights;
+ties break to the emptier queue, then round-robin.  A request carrying
+a deadline skips replicas whose estimated start delay
+(``backlog_tokens × measured token-latency``) already overruns it.
+
+**Admission control** (shed BEFORE the pager fires).  The router owns
+the fleet :class:`~chainermn_tpu.observability.slo.SLOTracker` (every
+replica feeds TTFT/throughput observations into it) and sheds load
+with machine-readable rejections while the pages are still
+*approaching*: when the short-window burn rate crosses
+``shed_burn_threshold`` (default 1.0× budget — the level that, held,
+eventually pages at ``burn_threshold``×) and the fleet has backlog, new
+work is refused with ``AdmissionError(reason="shed_slo")`` carrying
+``retry_after_ms`` and the fleet queue depth.  Deadline-infeasible
+requests (no replica can start in time) shed the same way — a request
+that will blow its deadline in the queue only burns budget.  Full
+queues everywhere reject ``queue_full`` with the same payload.
+Degradation is therefore by EXPLICIT REJECTION, never by queue
+collapse: admitted requests' TTFT stays bounded by the queues the
+router refused to overfill (the overload acceptance test in
+tests/test_serving_router.py asserts this via the goodput ledger's
+queue-wait split).
+
+**Observability** (the ISSUE 5 triad, fleet-wide).  The router MINTS
+each request's ``trace_id`` before dispatch and passes it through the
+replica hop, so one merged Perfetto doc shows ``router/dispatch`` →
+queue-wait → prefill/prefix-copy → per-tick spans under a single id.
+Rejections are counted per reason in :meth:`metrics` (→ ``/metricsz``)
+and streamed as ``router_rejection`` records in the serving JSONL;
+``/statusz`` aggregates every replica's ``introspect_state()`` under
+the ``router`` flight provider.  See docs/SERVING.md "Router, prefix
+cache & admission".
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from .. import observability as obs
+from ..observability import flight as _flight
+from ..observability.slo import SLOTracker
+from .frontend import RequestHandle
+from .replica import Replica
+from .scheduler import AdmissionError
+
+#: Rejection reasons the router can emit (PR 3's two + ISSUE 7's one).
+REJECT_REASONS = ("queue_full", "too_long", "shed_slo")
+
+
+class ServingRouter:
+    """Process-level router fronting N :class:`Replica` engines.
+
+    ``slo``: the FLEET tracker (shared by every replica's engine so all
+    TTFT/throughput observations land in one burn-rate budget); when
+    None, ``shed_slo`` only fires on deadline infeasibility.
+    ``shed_burn_threshold``: short-window burn rate above which new
+    work is shed while backlog exists — set BELOW the tracker's paging
+    ``burn_threshold`` so shedding starts before the page.
+    """
+
+    def __init__(self, replicas: Sequence[Replica], *,
+                 slo: Optional[SLOTracker] = None,
+                 shed_burn_threshold: float = 1.0,
+                 default_token_latency_ms: float = 20.0,
+                 metrics_writer=None,
+                 clock: Callable[[], float] = time.monotonic):
+        if not replicas:
+            raise ValueError("need at least one replica")
+        self.replicas: List[Replica] = list(replicas)
+        names = [r.name for r in self.replicas]
+        if len(set(names)) != len(names):
+            raise ValueError(f"replica names must be unique: {names}")
+        self.slo = slo
+        self.shed_burn_threshold = float(shed_burn_threshold)
+        self.default_token_latency_ms = float(default_token_latency_ms)
+        self.metrics_writer = metrics_writer
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._ids = itertools.count()
+        self._rr = 0                      # round-robin tie-breaker
+        self._dispatched = 0
+        self._dispatched_by: Dict[str, int] = {n: 0 for n in names}
+        self._rejected: Dict[str, int] = {r: 0 for r in REJECT_REASONS}
+        self._affinity_hits = 0           # dispatches won by prefix len
+        _flight.register_provider("router", self.introspect_state)
+
+    # ---- submission ----
+    def submit(self, prompt, max_new_tokens: int, *,
+               eos_id: Optional[int] = None,
+               deadline_s: Optional[float] = None,
+               on_token=None) -> RequestHandle:
+        """Dispatch to the best replica or raise :class:`AdmissionError`
+        with a machine-readable reason + ``retry_after_ms`` +
+        ``queue_depth`` (the shape ``.to_dict()`` serializes for 429
+        bodies and the JSONL stream)."""
+        trace_id = f"req-{os.getpid():x}-rt{next(self._ids):08x}"
+        t0_us = obs.now_us()
+        loads = [r.load() for r in self.replicas]
+        fleet_depth = sum(ld["queue_depth"] for ld in loads)
+
+        # SLO-aware shedding: refuse while the burn rate is climbing
+        # and a backlog exists — BEFORE the multi-window pager fires
+        if self.slo is not None and fleet_depth > 0:
+            burns = [self.slo.burn_rate(m, self.slo.windows_s[0])
+                     for m in ("ttft", "throughput")]
+            burning = [b for b in burns if b is not None
+                       and b > self.shed_burn_threshold]
+            if burning:
+                self._reject(
+                    "shed_slo", trace_id,
+                    f"short-window burn rate {max(burning):.2f}x exceeds "
+                    f"shed threshold {self.shed_burn_threshold}x with "
+                    f"{fleet_depth} queued",
+                    retry_after_ms=self._retry_after_ms(loads),
+                    queue_depth=fleet_depth)
+
+        candidates = []
+        for i, (rep, ld) in enumerate(zip(self.replicas, loads)):
+            if ld["queue_depth"] >= ld["queue_capacity"]:
+                continue   # full: submitting would be rejected anyway
+            wait_ms = ld["backlog_tokens"] * rep.token_latency_ms(
+                self.default_token_latency_ms)
+            if deadline_s is not None and wait_ms / 1e3 >= deadline_s:
+                continue   # cannot start before the deadline
+            match_len = rep.peek_prefix_len(prompt)
+            score = match_len - ld["backlog_tokens"]
+            candidates.append((score, -ld["queue_depth"], i, rep,
+                               match_len))
+        if not candidates:
+            if all(ld["queue_depth"] >= ld["queue_capacity"]
+                   for ld in loads):
+                self._reject(
+                    "queue_full", trace_id,
+                    f"all {len(self.replicas)} replica queues at "
+                    f"capacity",
+                    retry_after_ms=self._retry_after_ms(loads),
+                    queue_depth=fleet_depth)
+            # queues have room but no replica can meet the deadline:
+            # starting it anyway would only burn SLO budget
+            self._reject(
+                "shed_slo", trace_id,
+                "no replica can start before the request deadline "
+                f"(deadline_s={deadline_s})",
+                retry_after_ms=self._retry_after_ms(loads),
+                queue_depth=fleet_depth)
+
+        # max score, then emptier queue, then round-robin (the i-index
+        # rotation keeps a tied fleet evenly loaded)
+        rr = self._rr
+        best = max(candidates,
+                   key=lambda c: (c[0], c[1], -((c[2] - rr)
+                                                % len(self.replicas))))
+        _, _, idx, rep, match_len = best
+        self._rr = (idx + 1) % len(self.replicas)
+        try:
+            handle = rep.submit(prompt, max_new_tokens, eos_id=eos_id,
+                                deadline_s=deadline_s, on_token=on_token,
+                                trace_id=trace_id)
+        except AdmissionError as e:
+            # per-request races (another thread filled the queue) and
+            # too_long both surface here; re-raise with the router's
+            # payload attached so every rejection is uniformly shaped
+            self._reject(e.reason, trace_id, str(e),
+                         retry_after_ms=self._retry_after_ms(loads),
+                         queue_depth=fleet_depth)
+        with self._lock:
+            self._dispatched += 1
+            self._dispatched_by[rep.name] += 1
+            if match_len > 0:
+                self._affinity_hits += 1
+        obs.complete_event(
+            "router/dispatch", t0_us, obs.now_us() - t0_us,
+            cat="serving_request", trace_id=trace_id, replica=rep.name,
+            prefix_match_len=match_len, fleet_queue_depth=fleet_depth)
+        _flight.note("router", event="dispatched", trace_id=trace_id,
+                     replica=rep.name, prefix_match_len=match_len)
+        return handle
+
+    def _retry_after_ms(self, loads) -> float:
+        """Back-off hint: the LEAST-loaded replica's estimated time to
+        drain one queue slot — clients retrying after it land exactly
+        when capacity plausibly exists (floor 1ms keeps it truthy)."""
+        per_tok = [r.token_latency_ms(self.default_token_latency_ms)
+                   for r in self.replicas]
+        est = min(ld["backlog_tokens"] * ms
+                  for ld, ms in zip(loads, per_tok))
+        return max(float(est), 1.0)
+
+    def _reject(self, reason: str, trace_id: str, detail: str, *,
+                retry_after_ms: float, queue_depth: int):
+        with self._lock:
+            self._rejected[reason] = self._rejected.get(reason, 0) + 1
+        err = AdmissionError(reason, detail,
+                             retry_after_ms=retry_after_ms,
+                             queue_depth=queue_depth)
+        obs.instant("router/rejected", cat="serving", reason=reason,
+                    trace_id=trace_id, queue_depth=queue_depth)
+        _flight.note("router", event="rejected", reason=reason,
+                     trace_id=trace_id, detail=detail)
+        if self.metrics_writer is not None:
+            self.metrics_writer.write(
+                dict({f"router/{k}": v for k, v in err.to_dict().items()
+                      if not isinstance(v, str)},
+                     reason=reason, trace_id=trace_id),
+                kind="router_rejection")
+        raise err
+
+    # ---- driving ----
+    def step(self) -> int:
+        """ONE fleet scheduling round: step every replica that has
+        work; returns how many did (0 == drained).  The deterministic
+        single-thread driver the tests and bench use; production runs
+        :meth:`start` instead."""
+        stepped = 0
+        for rep in self.replicas:
+            if not rep.idle:
+                rep.step()
+                stepped += 1
+        return stepped
+
+    def run(self, steps_budget: Optional[int] = None) -> int:
+        """Drive :meth:`step` until the fleet drains or the budget
+        runs out; returns rounds run."""
+        n = 0
+        while steps_budget is None or n < steps_budget:
+            if self.step() == 0:
+                break
+            n += 1
+        return n
+
+    def start(self) -> None:
+        for rep in self.replicas:
+            rep.start()
+
+    def stop(self) -> None:
+        for rep in self.replicas:
+            rep.stop()
+
+    def close(self) -> None:
+        for rep in self.replicas:
+            rep.close()
+        if _flight._PROVIDERS.get("router") == self.introspect_state:
+            _flight.unregister_provider("router")
+
+    def reset_stats(self) -> None:
+        """Zero router counters AND every replica's rolling stats —
+        call after warm-up so steady-state numbers don't absorb the
+        one-off compiles (bench.py's serving_router section does)."""
+        with self._lock:
+            self._dispatched = 0
+            self._dispatched_by = {n: 0 for n in self._dispatched_by}
+            self._rejected = {r: 0 for r in REJECT_REASONS}
+            self._affinity_hits = 0
+        for rep in self.replicas:
+            rep.engine.reset_stats()
+
+    # ---- metrics / introspection ----
+    def metrics(self) -> Dict[str, float]:
+        """Fleet summary + per-reason rejection counters (the
+        ``/metricsz`` ``extra_gauges`` payload and the bench section's
+        source).  ``shed``/``rejected`` keys are lower-is-better under
+        the regression gate's direction inference."""
+        with self._lock:
+            dispatched = self._dispatched
+            rejected = dict(self._rejected)
+            affinity = self._affinity_hits
+        out: Dict[str, float] = {
+            "router/replicas": float(len(self.replicas)),
+            "router/dispatched_total": float(dispatched),
+            "router/affinity_dispatches_total": float(affinity),
+            "router/rejected_total": float(sum(rejected.values())),
+        }
+        for reason in REJECT_REASONS:
+            out[f"router/rejected/{reason}"] = float(
+                rejected.get(reason, 0))
+        offered = dispatched + sum(rejected.values())
+        out["router/shed_rate"] = (
+            sum(rejected.values()) / offered if offered else 0.0)
+        # fleet roll-ups from the engines' own metrics (one source of
+        # truth); TTFT percentiles merge the replica reservoirs
+        tps = occ = 0.0
+        ttft_vals: List[float] = []
+        for rep in self.replicas:
+            m = rep.engine.metrics()
+            tps += m["serving/tokens_per_sec"]
+            occ += m["serving/slot_occupancy_pct"]
+            ttft_vals.extend(rep.engine._ttft_ms.values())
+            for k, v in m.items():
+                out[f"router/{rep.name}/{k.split('/', 1)[1]}"] = v
+        out["router/fleet_tokens_per_sec"] = tps
+        out["router/fleet_slot_occupancy_pct"] = occ / len(self.replicas)
+        if ttft_vals:
+            from ..observability.slo import percentile_of
+            out["router/fleet_ttft_p50_ms"] = percentile_of(ttft_vals, 50)
+            out["router/fleet_ttft_p99_ms"] = percentile_of(ttft_vals, 99)
+        return out
+
+    def requests_table(self) -> Dict[str, Any]:
+        """Merged /requestz payload: every replica's table, tagged."""
+        tables = {rep.name: rep.engine.requests_table()
+                  for rep in self.replicas}
+        return {"schema": "chainermn_tpu.requestz.v1",
+                "fleet": True, "replicas": tables}
+
+    def introspect_state(self) -> Dict[str, Any]:
+        """The ``router`` flight/statusz provider: dispatch + rejection
+        counters and EVERY replica's ``introspect_state()`` — the
+        fleet-wide "what is it doing right now"."""
+        with self._lock:
+            state: Dict[str, Any] = {
+                "replicas": [rep.name for rep in self.replicas],
+                "dispatched": self._dispatched,
+                "dispatched_by": dict(self._dispatched_by),
+                "rejected": dict(self._rejected),
+                "affinity_dispatches": self._affinity_hits,
+            }
+        state["replica_state"] = {
+            rep.name: rep.engine.introspect_state()
+            for rep in self.replicas}
+        if self.slo is not None:
+            state["slo"] = self.slo.status()
+        return state
+
+    def finalize_metrics(self) -> None:
+        """Append the ``router_summary`` JSONL record (per-reason
+        rejection counters ride the serving stream; satellite 1)."""
+        if self.metrics_writer is not None:
+            self.metrics_writer.write(self.metrics(),
+                                      kind="router_summary")
+
+    def write_prometheus(self, path: str) -> str:
+        from ..observability.export import write_prometheus_textfile
+        return write_prometheus_textfile(path, extra_gauges=self.metrics())
+
+
+def build_fleet(params, n_replicas: int, *,
+                slo: Optional[SLOTracker] = None,
+                metrics_writer=None,
+                shed_burn_threshold: float = 1.0,
+                **engine_kwargs) -> ServingRouter:
+    """Stand up N identically-configured replicas behind one router —
+    the ``serve --replicas N`` CLI face.  The fleet SLO tracker is
+    shared into every engine so all observations burn one budget."""
+    replicas = [
+        Replica.build(params, f"replica{i}", slo=slo, **engine_kwargs)
+        for i in range(int(n_replicas))]
+    return ServingRouter(replicas, slo=slo,
+                         shed_burn_threshold=shed_burn_threshold,
+                         metrics_writer=metrics_writer)

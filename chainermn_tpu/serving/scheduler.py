@@ -37,14 +37,37 @@ class AdmissionError(Exception):
     """Backpressure signal: the request was REJECTED, with a reason.
 
     ``reason`` is machine-readable: ``queue_full`` (bounded queue at
-    capacity — retry later / shed load upstream) or ``too_long`` (the
+    capacity — retry later / shed load upstream), ``too_long`` (the
     request can never fit: prompt + max_new_tokens exceeds the pool's
-    per-slot capacity or the model's position table).
+    per-slot capacity or the model's position table), or ``shed_slo``
+    (the router's SLO-aware admission control shed the request BEFORE
+    the burn-rate tracker pages — degrade by rejecting, not by letting
+    the queues collapse; ISSUE 7).
+
+    ``retry_after_ms`` / ``queue_depth`` ride along when the rejecting
+    layer can estimate them (the router always fills both) so a client
+    can back off intelligently instead of hammering; ``to_dict()`` is
+    the wire shape the serving JSONL stream and HTTP 429 bodies carry.
     """
 
-    def __init__(self, reason: str, detail: str = ""):
+    def __init__(self, reason: str, detail: str = "", *,
+                 retry_after_ms: Optional[float] = None,
+                 queue_depth: Optional[int] = None):
         self.reason = reason
+        self.detail = detail
+        self.retry_after_ms = (None if retry_after_ms is None
+                               else float(retry_after_ms))
+        self.queue_depth = (None if queue_depth is None
+                            else int(queue_depth))
         super().__init__(f"{reason}: {detail}" if detail else reason)
+
+    def to_dict(self) -> dict:
+        out = {"reason": self.reason, "detail": self.detail}
+        if self.retry_after_ms is not None:
+            out["retry_after_ms"] = round(self.retry_after_ms, 3)
+        if self.queue_depth is not None:
+            out["queue_depth"] = self.queue_depth
+        return out
 
 
 class Request:
@@ -60,7 +83,15 @@ class Request:
     event, flight-recorder entry, ``/requestz`` row, and streamed token
     record this request produces, so one grep correlates a request
     across the Perfetto timeline, the metrics stream, and a postmortem
-    bundle.
+    bundle.  A caller-supplied ``trace_id`` (the router mints one per
+    request BEFORE dispatch, ISSUE 7) survives the hop unchanged so
+    router-side and replica-side spans merge into one Perfetto lane.
+
+    ``forced`` holds prompt-suffix tokens a prefix-cache hit still owes
+    the engine: the cached prefix's K/V was copied in, and the suffix
+    is consumed one token per decode tick (each tick writes the
+    consumed token's K/V row; its prediction is discarded until the
+    LAST prompt token, whose prediction is the first generated token).
     """
 
     _ids = itertools.count()
@@ -68,11 +99,12 @@ class Request:
     def __init__(self, prompt, max_new_tokens: int,
                  eos_id: Optional[int] = None,
                  deadline_t: Optional[float] = None,
-                 on_token: Optional[Callable] = None):
+                 on_token: Optional[Callable] = None,
+                 trace_id: Optional[str] = None):
         self.id = next(Request._ids)
         # pid disambiguates across engine restarts on one box; the
         # counter disambiguates within the process
-        self.trace_id = f"req-{os.getpid():x}-{self.id:08x}"
+        self.trace_id = trace_id or f"req-{os.getpid():x}-{self.id:08x}"
         self.prompt = prompt
         self.prompt_len = len(prompt)
         self.max_new_tokens = int(max_new_tokens)
@@ -85,6 +117,10 @@ class Request:
         self.slot: Optional[int] = None
         self.timestamps = {}
         self.done_event = threading.Event()
+        # prefix-cache state (ISSUE 7): set at admission on a hit
+        self.forced: Deque[int] = deque()  # prompt suffix still to feed
+        self.prefix_entry = None           # pinned PrefixEntry, or None
+        self.prefix_len = 0                # cached tokens skipped
 
     def finish(self, reason: str, now: float) -> None:
         self.status = "done" if reason in ("eos", "max_tokens") else "evicted"
@@ -166,6 +202,15 @@ class Scheduler:
                 out.append(self._queue.popleft())
                 n -= 1
         return out
+
+    def requeue_front(self, req: Request) -> None:
+        """Put an already-admitted request back at the queue HEAD
+        (FIFO preserved) when its slot fell through — e.g. a sibling
+        admission's prefix hit pinned the cached slot this one was
+        counting on scavenging.  Bypasses the capacity check: the
+        request was already accepted once and must not be re-rejected."""
+        with self._lock:
+            self._queue.appendleft(req)
 
     # ---- eviction ----
     def eviction_reason(self, req: Request, now: float) -> Optional[str]:

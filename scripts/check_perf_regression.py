@@ -65,11 +65,13 @@ _SKIP = re.compile(
 #: Lower-is-better key fingerprints (everything else: higher is better).
 #: slowdown/imbalance/drift come from the skew report; anomaly counts,
 #: dropped-event and rejected-request tallies are failure tallies — more
-#: is worse (rejected: the serving engine's backpressure counter).
+#: is worse (rejected: the serving engine's backpressure counter;
+#: shed: the router's SLO-aware load shedding — a higher shed rate at
+#: the same offered load means less goodput).
 _LOWER = re.compile(
     r"(time|_ms|ms_|/ms$|^ms$|latency|seconds|_s$|/s$|bytes|loss|"
     r"step_ms|gap|slowdown|imbalance|drift|anomal|dropped|findings|"
-    r"rejected)",
+    r"rejected|shed)",
     re.IGNORECASE)
 
 
