@@ -805,6 +805,156 @@ def bench_serving_router():
     }
 
 
+def bench_elastic_resume():
+    """Elastic/preemption robustness perf (ISSUE 8, docs/ROBUSTNESS.md):
+    what fault tolerance actually costs, on the gate.
+
+    * ``save_latency_s`` / ``restore_latency_s`` — one v2-manifest
+      checkpoint generation (sync write path) of a ~6 MB state.
+    * ``reshard_wall_s`` — the host-side n=4 → n=2 re-partition
+      (``reshard_host``) of that state per the manifest layout: the
+      added cost of resuming on a SMALLER world.
+    * ``steps_to_recover_*`` — through the REAL maybe_load machinery: a
+      run preempted at iteration 13 with periodic saves every 5.  The
+      bounded-grace final save makes recovery exact (0 steps replayed);
+      without it the periodic cadence pays its expected replay (3 here).
+    * ``prefetch_step_ms_off/on`` + ``prefetch_gain_frac`` — the
+      double-buffered input pipeline (ROADMAP 5a): demo-MLP steps with
+      the synchronous handoff vs the one-deep background prefetcher.
+      ``prefetch_gain_frac`` is the throughput gain, i.e. the
+      ``mfu_useful`` delta the goodput bucket table books (the compute
+      FLOPs are unchanged; only wall time moves).
+
+    Runs on every backend (host-side machinery + the CPU demo step);
+    keys ride bench_history.jsonl, latency/steps lower-is-better under
+    scripts/check_perf_regression.py.
+    """
+    import shutil
+    import tempfile
+
+    import jax
+    import numpy as np
+    import optax
+
+    import chainermn_tpu as mn
+    from chainermn_tpu.extensions import create_multi_node_checkpointer
+    from chainermn_tpu.iterators import SerialIterator
+    from chainermn_tpu.parallel.reshard import reshard_host
+    from chainermn_tpu.train import make_demo_step, replicate
+    from chainermn_tpu.training.updaters import StandardUpdater
+
+    rng = np.random.RandomState(0)
+    # ~6 MB: a small model's params + one flat optimizer-moment vector
+    # (the leaf shape ZeRO-1/elastic resume shards along axis 0)
+    state = {
+        "params": {f"w{i}": rng.randn(256, 256).astype(np.float32)
+                   for i in range(8)},
+        "m": rng.randn(16 * 256 * 256).astype(np.float32),
+        "iteration": 0,
+    }
+    state_mb = sum(a.nbytes for a in jax.tree_util.tree_leaves(state)
+                   if hasattr(a, "nbytes")) / 1e6
+    paths = [jax.tree_util.keystr(kp) for kp, _ in
+             jax.tree_util.tree_flatten_with_path(state)[0]]
+    m_key = next(p for p in paths if "'m'" in p)
+    layout = {m_key: ["sharded", 0]}
+    spec_host = {"params": {f"w{i}": None for i in range(8)}, "m": 0,
+                 "iteration": None}
+
+    comm = mn.create_communicator("xla", devices=jax.devices()[:1])
+    out = {"state_mb": round(state_mb, 1)}
+
+    tmp = tempfile.mkdtemp(prefix="bench-elastic-")
+    try:
+        cp = create_multi_node_checkpointer(
+            "bench", comm, path=tmp, keep=10, async_write=False,
+            layout=layout)
+        # save / restore latency (sync path: the number the preemption
+        # grace budget must cover)
+        saves = []
+        for rep in range(3):
+            t0 = time.perf_counter()
+            cp.save(state, iteration=rep)
+            saves.append(time.perf_counter() - t0)
+        out["save_latency_s"] = round(min(saves), 4)
+        t0 = time.perf_counter()
+        loaded, it = cp.maybe_load()
+        out["restore_latency_s"] = round(time.perf_counter() - t0, 4)
+        assert it == 2
+
+        # host-side elastic reshard n=4 -> n=2 (the resume-time add-on)
+        shards4 = reshard_host([state], None, spec_host, 4)
+        walls = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            shards2 = reshard_host(shards4, spec_host, spec_host, 2)
+            walls.append(time.perf_counter() - t0)
+        np.testing.assert_array_equal(
+            np.concatenate([s["m"] for s in shards2]), state["m"])
+        out["reshard_wall_s"] = round(min(walls), 4)
+        out["reshard_throughput_mb"] = round(state_mb / min(walls), 1)
+
+        # steps-to-recover through the real machinery: periodic saves at
+        # 5 and 10, preempted at 13 with the bounded-grace final save
+        cp.finalize()
+        cp = create_multi_node_checkpointer(
+            "bench", comm, path=tmp, keep=10, async_write=False,
+            layout=layout)
+        for it in (5, 10, 13):   # 13 = the preemption handler's save
+            state["iteration"] = it
+            cp.save(state, iteration=it)
+        _, resumed = cp.maybe_load()
+        out["steps_to_recover_final_save"] = 13 - resumed
+        os.unlink(cp._filename(13))           # no final save (SIGKILL)
+        _, resumed = cp.maybe_load()
+        out["steps_to_recover_periodic_only"] = 13 - resumed
+        cp.finalize()
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    # double-buffered input prefetch (ROADMAP 5a): demo step, sync vs
+    # prefetched host->device handoff
+    in_dim, n_classes, batch, steps = 32, 10, 256, 30
+    w_true = np.random.RandomState(42).randn(in_dim, n_classes)
+    xs = np.random.RandomState(0).randn(4096, in_dim).astype(np.float32)
+    ys = (xs @ w_true).argmax(-1).astype(np.int32)
+    dataset = list(zip(xs, ys))
+    mesh = comm.mesh
+    optimizer = optax.sgd(0.05, momentum=0.9)
+    params = {
+        "w1": (np.random.RandomState(1).randn(in_dim, 64) / 6
+               ).astype(np.float32),
+        "b1": np.zeros((64,), np.float32),
+        "w2": (np.random.RandomState(2).randn(64, n_classes) / 8
+               ).astype(np.float32),
+        "b2": np.zeros((n_classes,), np.float32),
+    }
+
+    def run_mode(prefetch):
+        step = make_demo_step(optimizer, mesh=mesh)
+        st = replicate((params, optimizer.init(params)), mesh)
+        upd = StandardUpdater(
+            SerialIterator(dataset, batch, seed=0), step, st, mesh=mesh,
+            prefetch=prefetch)
+        for _ in range(5):  # warm the compile + the prefetch pipeline
+            upd.update()
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            obs = upd.update()
+        wall = time.perf_counter() - t0
+        upd.close()
+        return wall / steps * 1e3, obs
+
+    off_ms, _ = run_mode(False)
+    on_ms, _ = run_mode(True)
+    out["prefetch_step_ms_off"] = round(off_ms, 3)
+    out["prefetch_step_ms_on"] = round(on_ms, 3)
+    # the mfu_useful delta: compute per step is identical, so the
+    # useful-throughput gain is exactly the wall-time ratio
+    out["prefetch_gain_frac"] = round(max(0.0, 1.0 - on_ms / off_ms), 4)
+    return out
+
+
 def scaling_worker(n, grad_dtype=None, double_buffering=False):
     """Subprocess body: weak-scaling point on an n-device virtual CPU mesh.
 
@@ -1475,6 +1625,22 @@ def main():
             emit()
     else:
         print("bench: over budget — serving_router section skipped",
+              file=sys.stderr)
+
+    # --- elastic resume: checkpoint/reshard/preemption cost (ISSUE 8) ------
+    # Every-backend contract (host-side machinery + the CPU demo step):
+    # save/restore latency, n=4->n=2 reshard wall time, steps-to-recover,
+    # and the prefetch on/off delta gate in bench_history.jsonl.
+    if not over_budget():
+        try:
+            result["elastic_resume"] = bench_elastic_resume()
+            emit("elastic_resume")
+        except Exception as e:
+            print(f"bench: elastic_resume section failed: {e!r}",
+                  file=sys.stderr)
+            emit()
+    else:
+        print("bench: over budget — elastic_resume section skipped",
               file=sys.stderr)
 
     # --- input pipeline: disk-fed vs synthetic -----------------------------

@@ -40,10 +40,186 @@ collective into the jitted step (SURVEY.md §3.2 "TPU mapping").
 
 from __future__ import annotations
 
-from typing import Any, Callable, List, Optional, Sequence
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import jax
 import numpy as np
+
+
+# ---------------------------------------------------------------------------
+# Hardened DCN lanes (ISSUE 8): retry/timeout/backoff with GANG-CONSISTENT
+# failure classification for the object-transport side channels
+# (allgather_obj / bcast_obj / the jax.distributed KV store).  A transient
+# lane fault (coordinator blip, connection reset) degrades gracefully via
+# exponential backoff; a permanent one dies loudly with the lane NAMED in
+# the flight ring and the raised error — never a silent hang.
+# ---------------------------------------------------------------------------
+
+class DcnLaneError(RuntimeError):
+    """Permanent (or retries-exhausted) failure of a named DCN lane.
+
+    Deliberately NOT caught anywhere in the package: it propagates to the
+    global except hook, which dumps a flight bundle (the ring's
+    ``dcn_lane_fault`` event names the lane) and aborts the gang — the
+    bounded loud death the chaos tests assert.
+    """
+
+    def __init__(self, lane: str, attempts: int, cause: BaseException):
+        self.lane = lane
+        self.attempts = attempts
+        self.cause = cause
+        super().__init__(
+            f"DCN lane '{lane}' failed after {attempts} attempt(s): "
+            f"{type(cause).__name__}: {cause}")
+
+
+class LaneConfig:
+    """Retry policy for one process's DCN lanes.
+
+    Every field reads an env override so a launcher can tune the whole
+    gang uniformly (classification AND policy must be gang-consistent —
+    per-rank divergence here could leave half the gang retrying while
+    the other half dies):
+
+    * ``CHAINERMN_TPU_LANE_RETRIES``       (default 4 transient retries)
+    * ``CHAINERMN_TPU_LANE_BACKOFF_S``     (base, default 0.05; doubles
+      per retry up to ``CHAINERMN_TPU_LANE_BACKOFF_MAX_S``, default 2.0)
+    * ``CHAINERMN_TPU_LANE_TIMEOUT_MS``    (blocking KV get, default
+      300000)
+    """
+
+    def __init__(self,
+                 max_retries: Optional[int] = None,
+                 backoff_base_s: Optional[float] = None,
+                 backoff_max_s: Optional[float] = None,
+                 timeout_ms: Optional[int] = None):
+        env = os.environ.get
+        self.max_retries = int(
+            env("CHAINERMN_TPU_LANE_RETRIES", 4)
+            if max_retries is None else max_retries)
+        self.backoff_base_s = float(
+            env("CHAINERMN_TPU_LANE_BACKOFF_S", 0.05)
+            if backoff_base_s is None else backoff_base_s)
+        self.backoff_max_s = float(
+            env("CHAINERMN_TPU_LANE_BACKOFF_MAX_S", 2.0)
+            if backoff_max_s is None else backoff_max_s)
+        self.timeout_ms = int(
+            env("CHAINERMN_TPU_LANE_TIMEOUT_MS", 300_000)
+            if timeout_ms is None else timeout_ms)
+
+
+#: Deterministic message fingerprints of TRANSIENT faults.  Classification
+#: keys on error TEXT, not type, so every rank seeing the same fault makes
+#: the same retry-vs-die call (the ``_mp_compute_unavailable`` discipline);
+#: anything not matching is PERMANENT — retrying an unknown error could
+#: desync lane sequence numbers across the gang.
+TRANSIENT_LANE_PATTERNS = (
+    "deadline exceeded",
+    "deadline_exceeded",
+    "unavailable",
+    "connection reset",
+    "connection refused",
+    "timed out",
+    "injected transient",        # the chaos harness's marker
+)
+
+
+def classify_lane_error(e: BaseException) -> str:
+    """``"transient"`` or ``"permanent"`` — total and deterministic."""
+    msg = str(e).lower()
+    if any(p in msg for p in TRANSIENT_LANE_PATTERNS):
+        return "transient"
+    return "permanent"
+
+
+#: Test/chaos fault injection: ``fn(lane, attempt)`` raising to simulate a
+#: fault, or None.  ``CHAINERMN_TPU_LANE_FAULT=<lane_substr>:<transient|
+#: permanent>:<count>`` arms an env-driven injector for subprocess gangs.
+_FAULT_INJECTOR: Optional[Callable[[str, int], None]] = None
+_ENV_FAULT: Optional[Dict[str, Any]] = None
+
+
+def set_lane_fault_injector(fn: Optional[Callable[[str, int], None]]) -> None:
+    global _FAULT_INJECTOR
+    _FAULT_INJECTOR = fn
+
+
+def _env_fault_state() -> Optional[Dict[str, Any]]:
+    global _ENV_FAULT
+    spec = os.environ.get("CHAINERMN_TPU_LANE_FAULT")
+    if not spec:
+        return None
+    if _ENV_FAULT is None or _ENV_FAULT.get("spec") != spec:
+        lane_substr, kind, count = spec.rsplit(":", 2)
+        _ENV_FAULT = {"spec": spec, "lane": lane_substr, "kind": kind,
+                      "remaining": int(count)}
+    return _ENV_FAULT
+
+
+def _maybe_inject_fault(lane: str, attempt: int) -> None:
+    if _FAULT_INJECTOR is not None:
+        _FAULT_INJECTOR(lane, attempt)
+    st = _env_fault_state()
+    if st and st["remaining"] > 0 and st["lane"] in lane:
+        st["remaining"] -= 1
+        if st["kind"] == "transient":
+            raise RuntimeError(
+                f"injected transient lane fault on '{lane}' (chaos)")
+        raise RuntimeError(
+            f"injected permanent lane fault on '{lane}' (chaos)")
+
+
+def lane_call(lane: str, fn: Callable[[], Any],
+              config: Optional[LaneConfig] = None) -> Any:
+    """Run one DCN-lane operation under the hardened retry discipline.
+
+    Transient faults (see :func:`classify_lane_error`) retry with
+    exponential backoff up to ``config.max_retries`` times, each retry
+    recorded in the flight ring (``dcn_lane_retry``); a permanent fault
+    or exhausted retries raises :class:`DcnLaneError` after recording
+    ``dcn_lane_fault`` — so the crash bundle always names the lane.
+
+    Retries are additionally bounded by TOTAL elapsed wall time
+    (``config.timeout_ms``): a blocking get that already waited the
+    full KV window gave the peer its whole budget — re-waiting it
+    ``max_retries`` more times would turn one 5-minute dead-peer
+    detection into 25 minutes of wedged accelerator, so a
+    timeout-classified fault past the budget dies loudly instead.
+    Fast-failing transients (connection refused/reset) are unaffected.
+    """
+    cfg = config or LaneConfig()
+    from ..observability import flight as _flight
+
+    attempt = 0
+    t_start = time.monotonic()
+    while True:
+        try:
+            _maybe_inject_fault(lane, attempt)
+            return fn()
+        except DcnLaneError:
+            raise
+        except Exception as e:  # noqa: BLE001 — classified below
+            kind = classify_lane_error(e)
+            attempt += 1
+            budget_spent = (time.monotonic() - t_start
+                            >= cfg.timeout_ms / 1000.0)
+            if kind == "permanent" or attempt > cfg.max_retries \
+                    or budget_spent:
+                _flight.note("dcn_lane_fault", lane=lane, attempts=attempt,
+                             classification=kind, error=repr(e))
+                import sys as _sys
+                print(f"[chainermn_tpu lanes] DCN lane '{lane}' "
+                      f"{'permanent fault' if kind == 'permanent' else 'transient fault persisted'}"
+                      f" after {attempt} attempt(s): {e!r}",
+                      file=_sys.stderr, flush=True)
+                raise DcnLaneError(lane, attempt, e) from e
+            delay = min(cfg.backoff_base_s * (2 ** (attempt - 1)),
+                        cfg.backoff_max_s)
+            _flight.note("dcn_lane_retry", lane=lane, attempt=attempt,
+                         backoff_s=round(delay, 4), error=repr(e))
+            time.sleep(delay)
 
 
 #: Concrete collectives auto-wrapped with observability accounting when a
@@ -132,6 +308,31 @@ class CommunicatorBase:
 
     def allgather_obj(self, obj: Any) -> List[Any]:
         raise NotImplementedError
+
+    def allgather_obj_eventual(self, tag: str, obj: Any,
+                               timeout_s: float = 10.0,
+                               discard_tag: Optional[str] = None
+                               ) -> Dict[int, Any]:
+        """Bounded best-effort per-PROCESS gather — deliberately NOT a
+        gang collective.  Each calling process publishes ``obj`` under a
+        caller-unique ``tag`` (include every identity the exchange is
+        scoped by — name, iteration, world size) and collects whatever
+        its peers published within ``timeout_s`` TOTAL (shared across
+        all peers, so a dead gang costs the budget once, not n-1
+        times); ``timeout_s <= 0`` publishes without reading any peer.
+        A peer that never calls (crashed, preempted, or simply skipping
+        this generation) is ABSENT from the returned
+        ``{process_index: obj}`` dict
+        instead of wedging the gang.  Safe to call from any subset of
+        processes in any order — the checkpoint manifest's checksum
+        exchange rides this so ``save()`` stays a LOCAL operation
+        (a dead peer degrades verification, never liveness).
+        ``discard_tag`` garbage-collects this process's entry from a
+        previous exchange.  Single-process backends: trivially complete.
+        """
+        del tag, timeout_s, discard_tag
+        import jax as _jax
+        return {_jax.process_index(): obj}
 
     def allreduce_obj(self, obj: Any, op: Callable = None) -> Any:
         raise NotImplementedError

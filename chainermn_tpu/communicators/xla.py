@@ -23,6 +23,7 @@ Data model: rank-major stacked global arrays — see ``base.py`` docstring.
 from __future__ import annotations
 
 import pickle
+import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Union
 
 import jax
@@ -32,7 +33,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .._compat import shard_map
 from ..topology import DEFAULT_AXIS_NAME, Topology, make_mesh
-from .base import CommunicatorBase
+from .base import CommunicatorBase, LaneConfig, lane_call
 
 
 class XlaCommunicator(CommunicatorBase):
@@ -65,6 +66,10 @@ class XlaCommunicator(CommunicatorBase):
         # object-lane collectives then go straight to the KV fallback
         # instead of re-running a failing multihost attempt per call.
         self._mp_compute_off = False
+        # Hardened-lane retry policy (env-tunable, gang-uniform): every
+        # KV-store operation below rides ``lane_call`` — transient faults
+        # back off and retry, permanent ones die loudly naming the lane.
+        self.lane_config = LaneConfig()
 
     # ---- topology ----
     @property
@@ -303,8 +308,12 @@ class XlaCommunicator(CommunicatorBase):
         self._obj_seq[("kv_exchange", tag)] = gen + 1
         client = self._kv_client()
         if me in src_procs:
-            client.key_value_set_bytes(
-                f"chainermn_tpu_xchg/{tag}/{gen}/{me}", payload or b"")
+            lane_call(
+                f"kv_store/set/{tag}",
+                lambda: self._kv_set_overwrite(
+                    client,
+                    f"chainermn_tpu_xchg/{tag}/{gen}/{me}", payload or b""),
+                self.lane_config)
             # GC: these exchanges are collective calls made in the same
             # order by every process, so by the time ANY process publishes
             # generation g every process has finished READING g-2 (it
@@ -319,10 +328,33 @@ class XlaCommunicator(CommunicatorBase):
                 except Exception:
                     pass  # older jaxlib without delete: leak, don't fail
         return {
-            p: client.blocking_key_value_get_bytes(
-                f"chainermn_tpu_xchg/{tag}/{gen}/{p}", 300_000)
+            p: lane_call(
+                f"kv_store/get/{tag}",
+                lambda p=p: client.blocking_key_value_get_bytes(
+                    f"chainermn_tpu_xchg/{tag}/{gen}/{p}",
+                    self.lane_config.timeout_ms),
+                self.lane_config)
             for p in src_procs
         }
+
+    @staticmethod
+    def _kv_set_overwrite(client, key: str, payload: bytes) -> None:
+        """KV set that stays IDEMPOTENT under lane retries: a transient
+        fault can strike after the coordinator applied the set but before
+        the client saw the reply, so the retry hits the same key — some
+        jaxlib versions refuse overwrite, which would misclassify the
+        recovered fault as permanent.  Delete-then-set absorbs it."""
+        try:
+            client.key_value_set_bytes(key, payload)
+        except Exception as set_err:
+            try:
+                client.key_value_delete(key)
+            except Exception:
+                # older jaxlib without delete (or delete itself faulted):
+                # surface the ORIGINAL set fault so lane_call classifies
+                # the real failure, not a masking AttributeError
+                raise set_err
+            client.key_value_set_bytes(key, payload)
 
     def _mp_compute_unavailable(self, e: Exception) -> bool:
         """True for the DETERMINISTIC backend-capability error ("…aren't
@@ -399,6 +431,66 @@ class XlaCommunicator(CommunicatorBase):
     def allgather_obj(self, obj: Any) -> List[Any]:
         return self.gather_obj(obj)
 
+    def allgather_obj_eventual(self, tag: str, obj: Any,
+                               timeout_s: float = 10.0,
+                               discard_tag: Optional[str] = None
+                               ) -> Dict[int, Any]:
+        """Bounded best-effort gather over the KV store (base contract).
+
+        Unlike ``_kv_exchange_obj`` there are NO generation counters —
+        keys are unique per (tag, process), so any subset of processes
+        may call, in any order, without desyncing the lockstep lanes.
+        The publish rides ``lane_call`` (``_kv_set_overwrite`` keeps the
+        retry idempotent — a re-publish of the same tag, e.g. a
+        preemption final save re-saving the periodic generation, is
+        legal); ``timeout_s`` is the TOTAL read budget shared across all
+        peers, so a gang of absent peers costs ``timeout_s`` once — not
+        per peer — and can never eat a preemption grace window n-1
+        times over.  ``timeout_s <= 0`` means publish-only: no peer
+        reads at all (the non-owner side of the manifest exchange).
+        """
+        me = jax.process_index()
+        if not self._multiprocess():
+            return {me: obj}
+        client = self._kv_client()
+        key = f"chainermn_tpu_evt/{tag}/{me}"
+        payload = pickle.dumps(obj)
+
+        lane_call(f"kv_store/evt_set/{tag}",
+                  lambda: self._kv_set_overwrite(client, key, payload),
+                  self.lane_config)
+        if discard_tag is not None and discard_tag != tag:
+            try:
+                client.key_value_delete(
+                    f"chainermn_tpu_evt/{discard_tag}/{me}")
+            except Exception:
+                pass  # GC best-effort; older jaxlib without delete
+        out = {me: obj}
+        if timeout_s <= 0:
+            return out
+        # Round-robin SHORT-SLICE polling, not one full-budget get per
+        # peer in index order: a published key returns instantly, so a
+        # dead low-index peer burns one slice per round instead of the
+        # whole budget — live higher-index peers' entries are still
+        # collected within the bound.
+        deadline = time.monotonic() + timeout_s
+        poll_ms = max(50, min(500, int(timeout_s * 1000) // 8))
+        pending = [p for p in range(jax.process_count()) if p != me]
+        while pending:
+            for p in list(pending):
+                remaining_ms = int((deadline - time.monotonic()) * 1000)
+                if remaining_ms <= 0:
+                    return out  # budget spent — whatever we have, degraded
+                try:
+                    data = client.blocking_key_value_get_bytes(
+                        f"chainermn_tpu_evt/{tag}/{p}",
+                        min(poll_ms, remaining_ms))
+                    out[p] = pickle.loads(data)
+                    pending.remove(p)
+                except Exception:
+                    pass  # absent this round — degraded, never wedged
+        return out
+
     def allreduce_obj(self, obj: Any, op: Callable = None) -> Any:
         op = op or (lambda a, b: a + b)
         gathered = self.allgather_obj(obj)
@@ -417,18 +509,29 @@ class XlaCommunicator(CommunicatorBase):
             seq = self._obj_seq.setdefault(("send", src, dest_proc), 0)
             self._obj_seq[("send", src, dest_proc)] = seq + 1
             key = f"chainermn_tpu_obj/{src}/{dest_proc}/{seq}"
-            self._kv_client().key_value_set_bytes(key, pickle.dumps(obj))
+            payload = pickle.dumps(obj)
+            lane_call(
+                "kv_store/send_obj",
+                lambda: self._kv_set_overwrite(
+                    self._kv_client(), key, payload),
+                self.lane_config)
             return
         self._obj_mailbox.append(pickle.dumps(obj))
 
-    def recv_obj(self, source: int, timeout_ms: int = 300_000) -> Any:
+    def recv_obj(self, source: int, timeout_ms: Optional[int] = None) -> Any:
         src_proc = self._devices[source].process_index
         if self._multiprocess() and src_proc != jax.process_index():
             me = jax.process_index()
             seq = self._obj_seq.setdefault(("recv", src_proc, me), 0)
             self._obj_seq[("recv", src_proc, me)] = seq + 1
             key = f"chainermn_tpu_obj/{src_proc}/{me}/{seq}"
-            data = self._kv_client().blocking_key_value_get_bytes(key, timeout_ms)
+            ms = self.lane_config.timeout_ms if timeout_ms is None \
+                else timeout_ms
+            data = lane_call(
+                "kv_store/recv_obj",
+                lambda: self._kv_client().blocking_key_value_get_bytes(
+                    key, ms),
+                self.lane_config)
             return pickle.loads(data)
         return pickle.loads(self._obj_mailbox.pop(0))
 

@@ -2,6 +2,7 @@
 
 from .allreduce_persistent import AllreducePersistent, allreduce_persistent  # noqa: F401
 from .checkpoint import (  # noqa: F401
+    MANIFEST_SCHEMA,
     MultiNodeCheckpointer,
     create_multi_node_checkpointer,
     reshard_checkpoint,
@@ -14,11 +15,13 @@ from .observation_aggregator import (  # noqa: F401
     ObservationAggregator,
     aggregate_observations,
 )
+from .preemption import PreemptionExit, PreemptionHandler  # noqa: F401
 from .watchdog import Watchdog  # noqa: F401
 
 __all__ = [
     "AllreducePersistent",
     "allreduce_persistent",
+    "MANIFEST_SCHEMA",
     "MultiNodeCheckpointer",
     "create_multi_node_checkpointer",
     "reshard_checkpoint",
@@ -26,5 +29,7 @@ __all__ = [
     "multi_node_snapshot",
     "ObservationAggregator",
     "aggregate_observations",
+    "PreemptionExit",
+    "PreemptionHandler",
     "Watchdog",
 ]

@@ -20,20 +20,55 @@ single background thread; the train loop continues immediately.  Depth is
 bounded at one in-flight write (a new save waits out the previous one),
 every read/consistency operation joins the writer first, and writer errors
 re-raise at the next checkpoint call instead of vanishing.
+
+Format v2 — world-size-independent checkpoints (ISSUE 8)
+--------------------------------------------------------
+Each generation now carries a per-generation MANIFEST
+(``{name}.iter{it}.world{n}.manifest.json``, written by the process
+owning rank 0) recording the schema, world size, partition LAYOUT
+(dotted leaf path → ``replicated`` / ``per_rank`` / ``["sharded",
+axis]``), logical leaf shapes, and a CRC32 per shard.  The checksum
+exchange rides ``allgather_obj_eventual`` — the BOUNDED, non-lockstep
+DCN side channel — never a gang collective: ``save()`` stays a LOCAL
+operation, so a peer that skips a generation, is mid-preemption, or is
+already dead degrades the manifest (its checksum is simply absent,
+``_verify_shard`` accepts that shard unverified) instead of wedging
+every survivor's save.  Two things fall out:
+
+* **Torn-shard tolerance** — ``_consistent_generations`` verifies every
+  local shard against its manifest checksum and silently excludes a
+  generation with a corrupt/truncated shard, so resume falls back to the
+  previous consistent one instead of unpickling garbage (a torn write at
+  the instant of death can no longer poison resume).
+* **Elastic resume** — ``maybe_load`` on a DIFFERENT process count finds
+  the newest gang-agreed old-world generation, reads ALL its shards
+  (shared filesystem assumed, as every elastic scheduler provides),
+  re-partitions them host-side via
+  :func:`chainermn_tpu.parallel.reshard.reshard_host` per the manifest
+  layout, and resumes the exact trajectory — iterator and optimizer
+  state included.  ChainerMN's fault-tolerant checkpoint required the
+  original rank count [uv]; here a preempted n=8 job continues on the
+  n=4 that survives (docs/ROBUSTNESS.md).
 """
 
 from __future__ import annotations
 
+import json
 import os
 import pickle
 import re
+import sys
 import tempfile
-from typing import Any, List, Optional, Tuple
+import zlib
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
 
 from ..communicators.base import CommunicatorBase
+
+#: Manifest schema stamp (bump on layout-incompatible changes).
+MANIFEST_SCHEMA = "chainermn_tpu.ckpt_manifest.v2"
 
 
 def _atomic_write(directory: str, target: str, payload: bytes) -> None:
@@ -56,6 +91,54 @@ def _to_host(tree):
         tree)
 
 
+def _crc(payload: bytes) -> int:
+    return zlib.crc32(payload) & 0xFFFFFFFF
+
+
+def _leaf_paths_and_shapes(state, layout: Optional[Dict[str, Any]],
+                           world: int) -> List[Dict[str, Any]]:
+    """``[{path, shape, dtype}]`` with LOGICAL shapes: a leaf the layout
+    declares sharded on axis ``a`` has its local axis-``a`` extent
+    multiplied by the world size (shards partition the logical array)."""
+    layout = layout or {}
+    out = []
+    for path, leaf in jax.tree_util.tree_flatten_with_path(state)[0]:
+        dotted = jax.tree_util.keystr(path)
+        arr = np.asarray(leaf) if not isinstance(leaf, np.ndarray) else leaf
+        shape = list(getattr(arr, "shape", ()))
+        spec = layout.get(dotted, "replicated")
+        if isinstance(spec, (list, tuple)) and spec and spec[0] == "sharded":
+            ax = int(spec[1])
+            if ax < len(shape):
+                shape[ax] = shape[ax] * world
+        out.append({"path": dotted, "shape": shape,
+                    "dtype": str(getattr(arr, "dtype", type(leaf).__name__))})
+    return out
+
+
+def _layout_spec_tree(state, layout: Optional[Dict[str, Any]]):
+    """Translate a dotted-path layout map into the per-leaf spec pytree
+    :func:`~chainermn_tpu.parallel.reshard.reshard_host` consumes:
+    ``None`` (replicated, the default), ``"per_rank"``, or an int axis."""
+    layout = layout or {}
+
+    def spec_of(dotted):
+        spec = layout.get(dotted, "replicated")
+        if spec in (None, "replicated"):
+            return None
+        if spec == "per_rank":
+            return "per_rank"
+        if isinstance(spec, (list, tuple)) and spec and spec[0] == "sharded":
+            return int(spec[1])
+        if isinstance(spec, int):
+            return spec
+        raise ValueError(f"unknown layout spec {spec!r} for {dotted!r}")
+
+    paths, treedef = jax.tree_util.tree_flatten_with_path(state)
+    return jax.tree_util.tree_unflatten(
+        treedef, [spec_of(jax.tree_util.keystr(p)) for p, _ in paths])
+
+
 class MultiNodeCheckpointer:
     """Sharded generation-based checkpointer with consistent auto-resume.
 
@@ -70,7 +153,9 @@ class MultiNodeCheckpointer:
 
     def __init__(self, name: str, comm: CommunicatorBase, path: str,
                  cp_interval: int = 5, gc_interval: int = 5, keep: int = 5,
-                 async_write: bool = True):
+                 async_write: bool = True,
+                 layout: Optional[Dict[str, Any]] = None,
+                 manifest: bool = True):
         self.name = name
         self.comm = comm
         self.path = path
@@ -84,6 +169,20 @@ class MultiNodeCheckpointer:
         self._async = bool(async_write)
         self._executor = None
         self._pending = None  # Future of the one in-flight write
+        #: dotted leaf path → "replicated" (default) | "per_rank" |
+        #: ["sharded", axis] — recorded in the generation manifest and
+        #: consumed by the elastic-restore reshard (docs/ROBUSTNESS.md).
+        self.layout = dict(layout or {})
+        self._manifest = bool(manifest)
+        #: How long the rank-0 owner waits for peer checksums before
+        #: writing a (possibly partial) manifest.  Only the owner pays
+        #: it, and only for peers that never publish — a skipped or dead
+        #: peer costs one bounded wait, never a wedge.
+        self.manifest_timeout_s = 5.0
+        self._sum_prev_tag: Optional[str] = None
+        # iteration of the last shard THIS process put on disk (the
+        # preemption bundle reports it)
+        self.last_saved_iteration: Optional[int] = None
         os.makedirs(path, exist_ok=True)
 
     # ---- naming ----
@@ -119,6 +218,61 @@ class MultiNodeCheckpointer:
     def _local_generations(self, any_world_size: bool = False) -> List[int]:
         return [it for it, _ in self._local_files(any_world_size)]
 
+    # ---- manifest (format v2) ----
+    def _manifest_path(self, iteration: int, nproc: Optional[int] = None
+                       ) -> str:
+        n = self._nproc if nproc is None else nproc
+        return os.path.join(
+            self.path,
+            f"{self.name}.iter{iteration:012d}.world{n}.manifest.json")
+
+    _MANIFEST_PAT = re.compile(
+        r"^(?P<name>.+)\.iter(?P<it>\d{12})\.world(?P<n>\d+)"
+        r"\.manifest\.json$")
+
+    def _read_manifest(self, iteration: int, nproc: Optional[int] = None
+                       ) -> Optional[Dict[str, Any]]:
+        p = self._manifest_path(iteration, nproc)
+        try:
+            with open(p) as f:
+                man = json.load(f)
+        except (FileNotFoundError, ValueError, OSError):
+            return None
+        if man.get("schema") != MANIFEST_SCHEMA:
+            return None
+        return man
+
+    def _write_manifest(self, iteration: int,
+                        checksums: Dict[int, int],
+                        leaves: List[Dict[str, Any]]) -> None:
+        man = {
+            "schema": MANIFEST_SCHEMA,
+            "name": self.name,
+            "iteration": iteration,
+            "world_size": self._nproc,
+            "kind": "proc",
+            "layout": self.layout,
+            "leaves": leaves,
+            "checksums": {str(p): int(c) for p, c in checksums.items()},
+        }
+        _atomic_write(
+            self.path, self._manifest_path(iteration),
+            json.dumps(man, sort_keys=True, indent=1).encode())
+
+    def _verify_shard(self, fname: str, manifest: Dict[str, Any],
+                      shard_key: str) -> bool:
+        """CRC the shard against the manifest; a missing manifest entry
+        counts as unverifiable-but-accepted (v1 compat), a mismatch or an
+        unreadable file as torn."""
+        want = (manifest.get("checksums") or {}).get(shard_key)
+        if want is None:
+            return True
+        try:
+            with open(fname, "rb") as f:
+                return _crc(f.read()) == int(want)
+        except OSError:
+            return False
+
     # ---- async writer plumbing ----
     def _join_writer(self) -> None:
         """Wait out the in-flight write; re-raise its error if it failed."""
@@ -150,67 +304,226 @@ class MultiNodeCheckpointer:
         mutable state (iterator orders, log accumulators) that the train
         loop keeps mutating; only the disk IO is deferred.
         """
-        payload = pickle.dumps(_to_host(state),
-                               protocol=pickle.HIGHEST_PROTOCOL)
+        host_state = _to_host(state)
+        payload = pickle.dumps(host_state, protocol=pickle.HIGHEST_PROTOCOL)
+        manifest_task = None
+        if self._manifest:
+            # NOT a gang collective: each process publishes its shard
+            # checksum on the bounded best-effort side channel
+            # (``allgather_obj_eventual``) and only the rank-0 owner —
+            # the manifest writer — waits (``manifest_timeout_s``) to
+            # collect them.  A peer that skips this generation or died
+            # mid-step is simply absent from the manifest (its shard
+            # loads unverified, v1-style); it can never wedge this
+            # process's save — the seed's skipped-save gang test and the
+            # preemption final save both depend on that.
+            checksum = _crc(payload)
+            tag = f"{self.name}.it{iteration}.w{self._nproc}"
+            owner = self.comm.owns_rank(0)
+            per_proc = self.comm.allgather_obj_eventual(
+                tag, checksum,
+                timeout_s=self.manifest_timeout_s if owner else 0.0,
+                discard_tag=self._sum_prev_tag)
+            self._sum_prev_tag = tag
+            checksums = {int(p): int(c) for p, c in per_proc.items()}
+            if owner:
+                leaves = _leaf_paths_and_shapes(host_state, self.layout,
+                                                self._nproc)
+                manifest_task = (iteration, checksums, leaves)
         if not self._async:
-            self._write(payload, iteration)
+            self._write(payload, iteration, manifest_task)
             return
         self._join_writer()  # bounded depth: one write in flight
-        self._submit(self._write, payload, iteration)
+        self._submit(self._write, payload, iteration, manifest_task)
 
-    def _write(self, payload: bytes, iteration: int) -> None:
+    def _write(self, payload: bytes, iteration: int,
+               manifest_task=None) -> None:
         _atomic_write(self.path, self._filename(iteration), payload)
+        if manifest_task is not None:
+            self._write_manifest(*manifest_task)
+        self.last_saved_iteration = iteration
         self._saves_since_gc += 1
         if self._saves_since_gc >= self.gc_interval:
             self._gc()
             self._saves_since_gc = 0
 
     def _gc(self) -> None:
-        """Drop all but the newest ``keep`` local generations."""
+        """Drop all but the newest ``keep`` local generations (plus the
+        manifests of dropped generations, if this process wrote them)."""
         gens = self._local_generations()
         for it in gens[:-self.keep]:
             try:
                 os.unlink(self._filename(it))
             except FileNotFoundError:
                 pass
+            if self.comm.owns_rank(0):
+                try:
+                    os.unlink(self._manifest_path(it))
+                except FileNotFoundError:
+                    pass
+        self._gc_other_worlds()
+
+    def _gc_other_worlds(self) -> None:
+        """After an elastic resume the OLD world's shards have no owning
+        process in the new world (`_gc` above matches only
+        ``proc{me}of{nproc}``), so a preempted n=8 job resumed at n=4
+        would leak ranks 4-7's shards forever.  The rank-0 owner deletes
+        other-world generations once a NEWER same-world save exists —
+        `_gc` only runs after a save, and saves only happen once every
+        process has passed ``maybe_load`` (training is collective), so
+        nobody is still reading them."""
+        if not self.comm.owns_rank(0) or self.last_saved_iteration is None:
+            return
+        newest = self.last_saved_iteration
+        for fn in os.listdir(self.path):
+            m = self._PAT.match(fn)
+            if (m and m.group("name") == self.name
+                    and int(m.group("nproc")) != self._nproc
+                    and int(m.group("it")) <= newest):
+                try:
+                    os.unlink(os.path.join(self.path, fn))
+                except FileNotFoundError:
+                    pass
+                continue
+            m = self._MANIFEST_PAT.match(fn)
+            if (m and m.group("name") == self.name
+                    and int(m.group("n")) != self._nproc
+                    and int(m.group("it")) <= newest):
+                try:
+                    os.unlink(os.path.join(self.path, fn))
+                except FileNotFoundError:
+                    pass
 
     def _consistent_generations(self) -> List[int]:
-        """Generations every process has (set intersection over DCN)."""
-        local = set(self._local_generations())
+        """Generations every process has with a CHECKSUM-CLEAN local
+        shard (set intersection over DCN).  A generation whose shard
+        fails its manifest CRC — the torn write of a process killed
+        mid-save — is excluded HERE, before the gang intersection, so
+        every process falls back to the same previous consistent
+        generation instead of unpickling garbage.  Generations without a
+        manifest (v1 / ``manifest=False``) are accepted unverified."""
+        local = set()
+        for it, fname in self._local_files():
+            man = self._read_manifest(it)
+            if man is not None and not self._verify_shard(
+                    fname, man, str(self._process)):
+                print(f"[chainermn_tpu checkpoint] shard {fname} fails "
+                      f"its manifest checksum (torn write?) — skipping "
+                      f"generation {it}", file=sys.stderr, flush=True)
+                continue
+            local.add(it)
         all_lists = self.comm.allgather_obj(sorted(local))
         consistent = local
         for other in all_lists:
             consistent &= set(other)
         return sorted(consistent)
 
-    def maybe_load(self, state: Any = None) -> Tuple[Any, Optional[int]]:
+    # ---- elastic resume (format v2 + reshard_host) ----
+    def _elastic_candidates(self) -> List[Tuple[int, int]]:
+        """(iteration, old_world) pairs this process can FULLY restore
+        from local/shared disk: a manifest exists for a DIFFERENT world
+        size and every one of its shards is present and checksum-clean."""
+        out = []
+        for fn in os.listdir(self.path):
+            m = self._MANIFEST_PAT.match(fn)
+            if not m or m.group("name") != self.name:
+                continue
+            old_n = int(m.group("n"))
+            it = int(m.group("it"))
+            if old_n == self._nproc:
+                continue
+            man = self._read_manifest(it, old_n)
+            if man is None:
+                continue
+            ok = True
+            for p in range(old_n):
+                shard = os.path.join(
+                    self.path,
+                    f"{self.name}.iter{it:012d}.proc{p}of{old_n}")
+                if not (os.path.exists(shard)
+                        and self._verify_shard(shard, man, str(p))):
+                    ok = False
+                    break
+            if ok:
+                out.append((it, old_n))
+        return sorted(out)
+
+    def _elastic_load(self, iteration: int, old_n: int) -> Any:
+        """Read every old-world shard, re-partition via ``reshard_host``
+        per the manifest layout, return THIS process's new shard."""
+        from ..parallel.reshard import reshard_host
+
+        man = self._read_manifest(iteration, old_n) or {}
+        shards = []
+        for p in range(old_n):
+            shard = os.path.join(
+                self.path,
+                f"{self.name}.iter{iteration:012d}.proc{p}of{old_n}")
+            with open(shard, "rb") as f:
+                shards.append(pickle.load(f))
+        layout = man.get("layout") or {}
+        spec_tree = _layout_spec_tree(shards[0], layout)
+        new_shards = reshard_host(shards, spec_tree, spec_tree, self._nproc)
+        print(f"[chainermn_tpu checkpoint] elastic resume: generation "
+              f"{iteration} resharded {old_n} -> {self._nproc} process(es)",
+              file=sys.stderr, flush=True)
+        return new_shards[self._process]
+
+    def maybe_load(self, state: Any = None, elastic: bool = True
+                   ) -> Tuple[Any, Optional[int]]:
         """Resume from the newest consistent generation, if any.
 
         Returns ``(state, iteration)``; ``(state, None)`` untouched when no
         consistent checkpoint exists (fresh start) — mirroring the
-        reference's ``maybe_load`` no-op contract [uv].  If shards exist but
-        NO generation is consistent across every process (world-size change,
-        or a save that crashed partway through the gang with nothing older
-        to fall back to), every process raises the same error — the decision
-        is taken on gang-agreed information so the job can never split into
-        crashed and fresh-started halves (the reference required same rank
-        count [uv]; here it is enforced, loudly and collectively).
+        reference's ``maybe_load`` no-op contract [uv].
+
+        **Elastic** (format v2, default on): when the newest restorable
+        generation was saved under a DIFFERENT world size, its shards are
+        re-partitioned host-side per the manifest layout
+        (:func:`~chainermn_tpu.parallel.reshard.reshard_host`) and every
+        process receives its new-world shard — a preempted n=8 job
+        resumes on the n=4 that survives.  Candidate agreement is
+        collective (intersection of what every process can fully verify
+        over the DCN object lane), so the gang can never split between a
+        resumed and a fresh-started half.  Same-world generations win
+        ties; a strictly NEWER other-world generation wins outright.
+
+        If shards exist but nothing is restorable (an interrupted v1 save
+        with nothing older, or manifest-less shards from another world
+        size), every process raises the same error on gang-agreed
+        information — loud and collective, exactly like the reference's
+        same-rank-count requirement [uv], minus the cases v2 makes
+        resumable.
         """
         self._join_writer()  # our newest shard must be on disk and visible
         gens = self._consistent_generations()
-        if not gens:
+        newest_same = gens[-1] if gens else None
+        newest_elastic: Optional[Tuple[int, int]] = None
+        if elastic:
+            cand_lists = self.comm.allgather_obj(self._elastic_candidates())
+            agreed = set(map(tuple, cand_lists[0]))
+            for other in cand_lists[1:]:
+                agreed &= set(map(tuple, other))
+            if agreed:
+                newest_elastic = max(agreed)
+        if newest_elastic is not None and (
+                newest_same is None or newest_elastic[0] > newest_same):
+            it, old_n = newest_elastic
+            return self._elastic_load(it, old_n), it
+        if newest_same is None:
             any_stale = any(self.comm.allgather_obj(
                 bool(self._local_generations(any_world_size=True))))
             if any_stale:
                 raise RuntimeError(
                     f"checkpoint shards for '{self.name}' exist in "
-                    f"{self.path} but no generation is consistent across "
-                    f"all {self._nproc} process(es) — the world size "
-                    "changed, or an interrupted save left only partial "
-                    "shards; resume with the original world size or delete "
-                    "the stale shards")
+                    f"{self.path} but no generation is restorable across "
+                    f"all {self._nproc} process(es) — an interrupted save "
+                    "left only partial/torn shards, or the world size "
+                    "changed and the shards carry no v2 manifest to "
+                    "reshard from; resume with the original world size or "
+                    "delete the stale shards (docs/ROBUSTNESS.md)")
             return state, None
-        it = gens[-1]
+        it = newest_same
         with open(self._filename(it), "rb") as f:
             loaded = pickle.load(f)
         return loaded, it
@@ -236,6 +549,14 @@ class MultiNodeCheckpointer:
                     os.unlink(path)
                 except FileNotFoundError:
                     pass
+            if self.comm.owns_rank(0):
+                for fn in os.listdir(self.path):
+                    m = self._MANIFEST_PAT.match(fn)
+                    if m and m.group("name") == self.name:
+                        try:
+                            os.unlink(os.path.join(self.path, fn))
+                        except FileNotFoundError:
+                            pass
 
     # ---- trainer-extension face (chainermn_tpu.training) ----
     # When registering directly (``trainer.extend(checkpointer)``) the save
@@ -255,14 +576,18 @@ def create_multi_node_checkpointer(
     path: Optional[str] = None,
     keep: int = 5,
     async_write: bool = True,
+    layout: Optional[Dict[str, Any]] = None,
+    manifest: bool = True,
 ) -> MultiNodeCheckpointer:
     """Factory with the reference's signature (``create_multi_node_checkpointer``
     [uv]); ``path`` defaults to ``./{name}-checkpoints`` like the reference's
-    cwd-relative default."""
+    cwd-relative default.  ``layout``/``manifest`` are the format-v2 knobs
+    (elastic resume + torn-shard tolerance — see class docstring)."""
     if path is None:
         path = os.path.join(os.getcwd(), f"{name}-checkpoints")
     return MultiNodeCheckpointer(name, comm, path, cp_interval, gc_interval,
-                                 keep, async_write)
+                                 keep, async_write, layout=layout,
+                                 manifest=manifest)
 
 
 def reshard_checkpoint(path: str, name: str, new_nproc: int,

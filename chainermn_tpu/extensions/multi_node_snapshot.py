@@ -25,13 +25,17 @@ located on resume.
 
 from __future__ import annotations
 
+import json
 import os
 import pickle
 import re
-from typing import Any, List, Optional, Sequence, Tuple
+import sys
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from ..communicators.base import CommunicatorBase
-from .checkpoint import MultiNodeCheckpointer, _atomic_write, _to_host
+from .checkpoint import (MANIFEST_SCHEMA, MultiNodeCheckpointer,
+                         _atomic_write, _crc, _leaf_paths_and_shapes,
+                         _to_host)
 
 
 def _normalize_sets(replica_sets: Sequence[Sequence[int]],
@@ -100,6 +104,36 @@ class MultiNodeSnapshot:
     _PAT = re.compile(
         r"^(?P<name>.+)\.iter(?P<it>\d{12})\.set(?P<sid>\d+)of(?P<n>\d+)$")
 
+    # ---- manifest (same format-v2 sidecar as MultiNodeCheckpointer,
+    # kind="set": one checksum per replica SET, not per process) ----
+    def _manifest_path(self, iteration: int) -> str:
+        return os.path.join(
+            self.ckpt.path,
+            f"{self.ckpt.name}.iter{iteration:012d}"
+            f".sets{self._nsets}.manifest.json")
+
+    def _read_manifest(self, iteration: int) -> Optional[Dict[str, Any]]:
+        try:
+            with open(self._manifest_path(iteration)) as f:
+                man = json.load(f)
+        except (FileNotFoundError, ValueError, OSError):
+            return None
+        return man if man.get("schema") == MANIFEST_SCHEMA else None
+
+    def _verify(self, iteration: int, set_id: int) -> bool:
+        """Shard vs manifest CRC; manifest-less generations pass (v1)."""
+        man = self._read_manifest(iteration)
+        if man is None:
+            return True
+        want = (man.get("checksums") or {}).get(str(set_id))
+        if want is None:
+            return True
+        try:
+            with open(self._filename(iteration, set_id), "rb") as f:
+                return _crc(f.read()) == int(want)
+        except OSError:
+            return False
+
     def _visible_generations(self, set_id: int,
                              any_layout: bool = False) -> List[int]:
         out = []
@@ -108,7 +142,14 @@ class MultiNodeSnapshot:
             if (m and m.group("name") == self.ckpt.name
                     and (any_layout or (int(m.group("sid")) == set_id
                                         and int(m.group("n")) == self._nsets))):
-                out.append(int(m.group("it")))
+                it = int(m.group("it"))
+                if not any_layout and not self._verify(it, set_id):
+                    print(f"[chainermn_tpu snapshot] set shard "
+                          f"{self._filename(it, set_id)} fails its "
+                          f"manifest checksum — skipping generation {it}",
+                          file=sys.stderr, flush=True)
+                    continue
+                out.append(it)
         return sorted(out)
 
     # ---- save / load ----
@@ -122,20 +163,62 @@ class MultiNodeSnapshot:
         one-deep async writer thread when it was built with
         ``async_write``, and its ``keep``/``gc_interval`` knobs govern
         the wrapper's own ``.setXofY`` generations."""
+        host_state = _to_host(state) if self._writer_sets else None
+        payload = (pickle.dumps(host_state,
+                                protocol=pickle.HIGHEST_PROTOCOL)
+                   if self._writer_sets else None)
+        manifest_task = None
+        if self.ckpt._manifest:
+            # NOT a gang collective (same discipline as the per-process
+            # checkpointer): every process publishes its set-id → shard
+            # checksum map (non-writers publish an empty one) on the
+            # bounded best-effort side channel, and only the rank-0
+            # owner — always a writer, rank 0 leads its own set — waits
+            # to collect before writing the kind="set" manifest.  A dead
+            # or skipping peer's sets go unverified, never wedge a save.
+            mine = ({sid: _crc(payload) for sid in self._writer_sets}
+                    if payload is not None else {})
+            owner = self.comm.owns_rank(0)
+            tag = f"{self.ckpt.name}.sets{self._nsets}.it{iteration}"
+            per_proc = self.comm.allgather_obj_eventual(
+                tag, mine,
+                timeout_s=self.ckpt.manifest_timeout_s if owner else 0.0,
+                discard_tag=self.ckpt._sum_prev_tag)
+            self.ckpt._sum_prev_tag = tag
+            checksums: Dict[int, int] = {}
+            for entry in per_proc.values():
+                checksums.update({int(k): int(v)
+                                  for k, v in (entry or {}).items()})
+            if owner:
+                manifest_task = {
+                    "schema": MANIFEST_SCHEMA,
+                    "name": self.ckpt.name,
+                    "iteration": iteration,
+                    "world_size": self._nsets,
+                    "kind": "set",
+                    "layout": self.ckpt.layout,
+                    "leaves": _leaf_paths_and_shapes(
+                        host_state, self.ckpt.layout, self._nsets),
+                    "checksums": {str(k): v for k, v in checksums.items()},
+                }
         if not self._writer_sets:
             return
-        payload = pickle.dumps(_to_host(state),
-                               protocol=pickle.HIGHEST_PROTOCOL)
         if not self.ckpt._async:
-            self._write(payload, iteration)
+            self._write(payload, iteration, manifest_task)
             return
         self.ckpt._join_writer()  # bounded depth: one write in flight
-        self.ckpt._submit(self._write, payload, iteration)
+        self.ckpt._submit(self._write, payload, iteration, manifest_task)
 
-    def _write(self, payload: bytes, iteration: int) -> None:
+    def _write(self, payload: bytes, iteration: int,
+               manifest_task=None) -> None:
         for sid in self._writer_sets:
             _atomic_write(self.ckpt.path, self._filename(iteration, sid),
                           payload)
+        if manifest_task is not None:
+            _atomic_write(
+                self.ckpt.path, self._manifest_path(iteration),
+                json.dumps(manifest_task, sort_keys=True, indent=1).encode())
+        self.ckpt.last_saved_iteration = iteration
         self.ckpt._saves_since_gc += 1
         if self.ckpt._saves_since_gc >= self.ckpt.gc_interval:
             self._gc()
@@ -149,6 +232,11 @@ class MultiNodeSnapshot:
                     os.unlink(self._filename(it, sid))
                 except FileNotFoundError:
                     pass
+                if self.comm.owns_rank(0):
+                    try:
+                        os.unlink(self._manifest_path(it))
+                    except FileNotFoundError:
+                        pass
 
     def flush(self) -> None:
         """Block until the in-flight async write (if any) is on disk."""

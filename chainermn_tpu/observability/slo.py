@@ -114,7 +114,11 @@ class GoodputLedger:
     bar, and the serve-demo acceptance keeps it under 5%.
     """
 
-    BUCKETS = ("compute", "comm", "host", "compile", "queue_wait", "stall")
+    #: ``checkpoint`` (ISSUE 8): final-save overhead on the preemption
+    #: path and periodic-save flush time — booked, not vanished, so the
+    #: goodput table shows what fault tolerance actually costs.
+    BUCKETS = ("compute", "comm", "host", "compile", "queue_wait", "stall",
+               "checkpoint")
 
     def __init__(self, wall_clock: Callable[[], float] = time.monotonic):
         self._clock = wall_clock

@@ -67,6 +67,13 @@ from .transformer import (  # noqa: F401
     transformer_lm_specs,
     vocab_parallel_logits_loss,
 )
+from .reshard import (  # noqa: F401
+    make_reshard,
+    reshard,
+    reshard_cost,
+    reshard_host,
+    reshard_tree_cost,
+)
 from .tensor_parallel import (  # noqa: F401
     column_parallel_dense,
     init_tp_mlp_params,
@@ -81,6 +88,11 @@ from .tensor_parallel import (  # noqa: F401
 )
 
 __all__ = [
+    "reshard",
+    "make_reshard",
+    "reshard_host",
+    "reshard_cost",
+    "reshard_tree_cost",
     "ring_attention",
     "make_ring_attention",
     "ulysses_attention",

@@ -382,6 +382,22 @@ def main(argv=None) -> int:
                              "and the cross-rank skew report is appended "
                              "at exit")
     parser.add_argument("--watchdog-timeout", type=float, default=1800.0)
+    parser.add_argument("--prefetch", action="store_true",
+                        help="double-buffered host->device input "
+                             "prefetch: batch k+1 assembles on a "
+                             "background thread while step k runs "
+                             "(exact-resume safe; see docs/ROBUSTNESS.md)")
+    parser.add_argument("--checkpoint-dir", default=None,
+                        help="enable v2 manifest checkpoints here "
+                             "(periodic saves every --checkpoint-every "
+                             "iters + auto-resume, including ELASTIC "
+                             "resume from a different world size)")
+    parser.add_argument("--checkpoint-every", type=int, default=5)
+    parser.add_argument("--preemption-grace-s", type=float, default=None,
+                        help="treat SIGTERM as a scheduler preemption: "
+                             "final async checkpoint + flight bundle + "
+                             "exit 0, all within this grace budget "
+                             "(requires --checkpoint-dir for the save)")
     parser.add_argument("--statusz-port", type=int, default=None,
                         help="live introspection HTTP server (/statusz "
                              "/metricsz /requestz /debugz) on this port; "
@@ -463,7 +479,7 @@ def main(argv=None) -> int:
 
     updater = StandardUpdater(
         SerialIterator(dataset, args.batchsize, seed=0), step, state,
-        mesh=mesh)
+        mesh=mesh, prefetch=args.prefetch)
     trainer = Trainer(updater, (args.steps, "iteration"), out=args.out)
     trainer.extend(ObservationAggregator(comm), trigger=(1, "iteration"),
                    priority=PRIORITY_EDITOR)
@@ -524,7 +540,35 @@ def main(argv=None) -> int:
         log, trigger=(args.log_every, "iteration")))
     trainer.extend(Watchdog(timeout=args.watchdog_timeout,
                             dump_dir=args.out, monitor=monitor, rank=rank))
+    # Elastic checkpointing + preemption (ISSUE 8, docs/ROBUSTNESS.md):
+    # v2 manifest checkpoints resume across WORLD-SIZE changes; SIGTERM
+    # inside the grace budget saves a final generation, books the save
+    # into the goodput ledger's `checkpoint` bucket, dumps a `preempt`
+    # bundle, and exits 0.
+    checkpointer = None
+    if args.checkpoint_dir:
+        from .extensions.checkpoint import create_multi_node_checkpointer
+        checkpointer = create_multi_node_checkpointer(
+            "train", comm, cp_interval=args.checkpoint_every,
+            path=args.checkpoint_dir)
+        trainer.extend(checkpointer,
+                       trigger=(args.checkpoint_every, "iteration"))
+        loaded, it_resumed = checkpointer.maybe_load()
+        if it_resumed is not None:
+            trainer.load_checkpoint_state(loaded)
+            print(f"[chainermn_tpu train] resumed from generation "
+                  f"{it_resumed} in {args.checkpoint_dir}",
+                  file=__import__("sys").stderr, flush=True)
+    if args.preemption_grace_s is not None:
+        from .extensions.preemption import PreemptionHandler
+        # installed AFTER the flight handlers: SIGTERM now means
+        # checkpoint-and-exit-0, SIGUSR1 stays dump-and-continue
+        preempt = PreemptionHandler(
+            checkpointer, grace_s=args.preemption_grace_s,
+            dump_dir=dump_dir or args.out, ledger=goodput, rank=rank)
+        trainer.extend(preempt)
     trainer.run()
+    updater.close()  # stop the prefetch thread (no-op when not prefetching)
 
     final = log.log[-1] if log.log else {}
     result = {

@@ -11,6 +11,8 @@ while the host prepares the next batch.
 
 from __future__ import annotations
 
+import queue
+import threading
 import time
 from typing import Any, Callable, Dict, Optional
 
@@ -20,6 +22,99 @@ import numpy as np
 from ..observability import trace as _trace
 from ..observability.comm import get_accountant as _get_accountant
 
+
+class _Prefetcher:
+    """One-deep background host→device input pipeline (ISSUE 8 / ROADMAP
+    5a): while step *k* runs on device, a daemon thread assembles batch
+    *k+1* (iterator pull + convert + sharded ``device_put``), so the
+    synchronous host→device handoff leaves the step's critical path —
+    the ``data`` phase collapses to a queue pop.
+
+    Exact-resume contract: each queued item carries the iterator
+    ``state_dict`` captured right AFTER its batch was pulled, i.e. the
+    state a resumed run needs so its next pull yields the FOLLOWING
+    batch.  The updater checkpoints that per-item state, not the live
+    iterator's (which runs up to two batches ahead), so prefetch never
+    perturbs the training trajectory across a preemption.
+
+    Errors raised while assembling (iterator exhaustion, converter bugs)
+    re-raise in ``update()`` on the main thread, never vanish.
+    """
+
+    def __init__(self, iterator, converter, place):
+        self.iterator = iterator
+        self.converter = converter
+        self.place = place
+        self._q: queue.Queue = queue.Queue(maxsize=1)
+        self._error: Optional[BaseException] = None
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True,
+            name="chainermn-tpu-input-prefetch")
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                batch = self.iterator.next()
+                meta = {
+                    "iterator_state": (self.iterator.state_dict()
+                                       if hasattr(self.iterator,
+                                                  "state_dict") else None),
+                    "epoch": getattr(self.iterator, "epoch", 0),
+                    "is_new_epoch": getattr(self.iterator, "is_new_epoch",
+                                            False),
+                    "epoch_detail": getattr(self.iterator, "epoch_detail",
+                                            None),
+                }
+                arrays = self.place(self.converter(batch))
+                item = ("batch", arrays, meta)
+            except BaseException as e:  # noqa: BLE001 — re-raised in update()
+                item = ("error", e, None)
+            # bounded put that stays responsive to close()
+            while not self._stop.is_set():
+                try:
+                    self._q.put(item, timeout=0.1)
+                    break
+                except queue.Full:
+                    continue
+            if item[0] == "error":
+                return
+
+    def get(self):
+        # Latched error: the worker thread exits after enqueueing one
+        # error item, so a caller that swallows the first raise (e.g. a
+        # loop treating StopIteration as epoch end) and calls again must
+        # re-raise, not block forever on an empty queue nobody feeds.
+        if self._error is not None:
+            raise self._error
+        kind, payload, meta = self._q.get()
+        if kind == "error":
+            self._error = payload
+            self.close()
+            raise payload
+        return payload, meta
+
+    def close(self) -> None:
+        self._stop.set()
+        # unblock a put-blocked thread
+        try:
+            self._q.get_nowait()
+        except queue.Empty:
+            pass
+        if self._thread.is_alive():
+            self._thread.join(timeout=5)
+            if self._thread.is_alive():
+                # Blocked inside iterator.next() (slow/streaming source):
+                # Python can't kill it, and its in-flight pull may mutate
+                # the iterator AFTER a load_state_dict restored the
+                # cursor — warn loudly instead of silently racing the
+                # exact-resume contract.
+                import sys
+                print("[chainermn_tpu prefetch] WARNING: prefetch worker "
+                      "still blocked in iterator.next() after close(); "
+                      "its in-flight pull may race a restored iterator "
+                      "cursor", file=sys.stderr, flush=True)
 
 
 def default_converter(batch):
@@ -42,7 +137,7 @@ class StandardUpdater:
     def __init__(self, iterator, step_fn: Callable, state: Any,
                  converter: Callable = default_converter,
                  mesh=None, axis_name: Optional[str] = None,
-                 shard: bool = True):
+                 shard: bool = True, prefetch: bool = False):
         self.iterator = iterator
         self.step_fn = step_fn
         self.state = state
@@ -62,18 +157,45 @@ class StandardUpdater:
                 self.mesh, P(self.mesh.axis_names[0]))
         else:
             self.mesh = mesh
+        # Double-buffered input (see _Prefetcher): batch k+1 assembles on
+        # a background thread while step k runs.  Epoch bookkeeping and
+        # the checkpointed iterator state come from the CONSUMED batch's
+        # snapshot, so triggers and elastic resume see the same trajectory
+        # as the synchronous path.
+        self.prefetch = bool(prefetch)
+        self._prefetcher: Optional[_Prefetcher] = None
+        self._consumed_meta: Optional[Dict[str, Any]] = None
+
+    def _place(self, arrays):
+        if self.shard:
+            return jax.tree_util.tree_map(
+                lambda x: jax.device_put(x, self._batch_sharding), arrays)
+        return arrays
 
     @property
     def epoch(self) -> int:
+        if self._consumed_meta is not None:
+            return self._consumed_meta["epoch"]
         return getattr(self.iterator, "epoch", 0)
 
     @property
     def is_new_epoch(self) -> bool:
+        if self._consumed_meta is not None:
+            return self._consumed_meta["is_new_epoch"]
         return getattr(self.iterator, "is_new_epoch", False)
 
     @property
     def epoch_detail(self) -> float:
+        if self._consumed_meta is not None \
+                and self._consumed_meta["epoch_detail"] is not None:
+            return self._consumed_meta["epoch_detail"]
         return getattr(self.iterator, "epoch_detail", float(self.epoch))
+
+    def close(self) -> None:
+        """Stop the prefetch thread (no-op without ``prefetch=True``)."""
+        if self._prefetcher is not None:
+            self._prefetcher.close()
+            self._prefetcher = None
 
     def update(self) -> Dict[str, Any]:
         # Step-time breakdown: the data phase (host batch assembly +
@@ -86,11 +208,14 @@ class StandardUpdater:
         tracer = _trace.get_tracer()
         t0 = time.perf_counter()
         with tracer.span("step/data", cat="phase"):
-            batch = self.iterator.next()
-            arrays = self.converter(batch)
-            if self.shard:
-                arrays = jax.tree_util.tree_map(
-                    lambda x: jax.device_put(x, self._batch_sharding), arrays)
+            if self.prefetch:
+                if self._prefetcher is None:
+                    self._prefetcher = _Prefetcher(
+                        self.iterator, self.converter, self._place)
+                arrays, self._consumed_meta = self._prefetcher.get()
+            else:
+                batch = self.iterator.next()
+                arrays = self._place(self.converter(batch))
         t1 = time.perf_counter()
         with _get_accountant().step("updater/step_fn"):
             with tracer.span("step/compute", cat="phase"):
@@ -106,7 +231,13 @@ class StandardUpdater:
     # ---- resume contract ----
     def state_dict(self) -> dict:
         out = {"iteration": self.iteration, "state": self.state}
-        if hasattr(self.iterator, "state_dict"):
+        if self.prefetch and self._consumed_meta is not None:
+            # the CONSUMED batch's iterator snapshot, not the live
+            # iterator's (which has prefetched ahead) — resuming from
+            # this replays exactly the batches the steps never saw
+            if self._consumed_meta["iterator_state"] is not None:
+                out["iterator"] = self._consumed_meta["iterator_state"]
+        elif hasattr(self.iterator, "state_dict"):
             out["iterator"] = self.iterator.state_dict()
         return out
 
@@ -118,5 +249,8 @@ class StandardUpdater:
             lambda tmpl, v: jax.device_put(v, tmpl.sharding)
             if isinstance(tmpl, jax.Array) else v,
             self.state, loaded)
+        # a running prefetcher holds batches pulled under the OLD cursor
+        self.close()
+        self._consumed_meta = None
         if "iterator" in state and hasattr(self.iterator, "load_state_dict"):
             self.iterator.load_state_dict(state["iterator"])

@@ -71,7 +71,7 @@ _SKIP = re.compile(
 _LOWER = re.compile(
     r"(time|_ms|ms_|/ms$|^ms$|latency|seconds|_s$|/s$|bytes|loss|"
     r"step_ms|gap|slowdown|imbalance|drift|anomal|dropped|findings|"
-    r"rejected|shed)",
+    r"rejected|shed|steps_to_recover)",
     re.IGNORECASE)
 
 

@@ -125,6 +125,21 @@ def explain(bundle: dict) -> dict:
             out["goodput"] = {
                 "goodput_frac": train["goodput"].get("goodput_frac"),
                 "buckets_frac": train["goodput"].get("buckets_frac")}
+    # preemption bundles (ISSUE 8): the scheduler took the node, not a
+    # bug — surface the grace accounting and the elastic resume hint
+    pre = (man.get("extra") or {}).get("preempt")
+    if isinstance(pre, dict):
+        out["preempt"] = {
+            "signal": pre.get("signal"),
+            "grace_budget_s": pre.get("grace_budget_s"),
+            "grace_used_s": pre.get("grace_used_s"),
+            "save_s": pre.get("save_s"),
+            "generation_saved": pre.get("generation_saved"),
+            "why_not_saved": pre.get("why_not_saved"),
+            "world_size": pre.get("world_size"),
+            "checkpoint_dir": pre.get("checkpoint_dir"),
+            "resume_hint": pre.get("resume_hint"),
+        }
     return out
 
 
@@ -153,6 +168,26 @@ def render_text(rep: dict) -> str:
         lines.append(f"  serving: {json.dumps(rep['serving'])}")
         lines.append(f"  requests at death: "
                      f"{json.dumps(rep['requests_at_death'])}")
+    if rep.get("preempt"):
+        pre = rep["preempt"]
+        used = pre.get("grace_used_s")
+        budget = pre.get("grace_budget_s")
+        lines.append(
+            f"  preemption: {pre.get('signal')} — grace used "
+            f"{used if used is not None else '?'}s of "
+            f"{budget if budget is not None else '?'}s"
+            + (f" (final save {pre['save_s']}s)"
+               if pre.get("save_s") is not None else ""))
+        if pre.get("generation_saved") is not None:
+            lines.append(
+                f"    generation saved: {pre['generation_saved']} "
+                f"(world size {pre.get('world_size')}, "
+                f"{pre.get('checkpoint_dir')})")
+        else:
+            lines.append(
+                f"    NOTHING saved: {pre.get('why_not_saved')}")
+        if pre.get("resume_hint"):
+            lines.append(f"    resume: {pre['resume_hint']}")
     if rep.get("final_events"):
         lines.append("  final ring events:")
         for ev in rep["final_events"]:

@@ -14,7 +14,27 @@ test asserts the FULL failure story end-to-end:
   generation that is consistent across ALL ranks (the victim's last save),
   finishes training, and reports success.
 
-Usage: python tests/_chaos_worker.py <n> <i> <port> <tmpdir> <crash|resume> \
+ISSUE 8 adds the elastic/preemption modes:
+
+* ``preempt`` — one victim receives a REAL SIGTERM mid-step; its
+  :class:`PreemptionHandler` saves a final generation at the step
+  boundary, dumps a ``preempt`` flight bundle, and exits 0 (a preempted
+  job is a SUCCESS to the scheduler).  The survivors' next DCN-lane
+  operation (KV-store object collective) can never complete — the
+  hardened lanes (``lane_call``) retry with backoff, then die LOUDLY
+  with a :class:`DcnLaneError` naming the lane and an
+  ``uncaught_exception`` bundle.  Zero silent hangs.
+* ``elastic_train`` / ``elastic_resume`` / ``elastic_base`` — the
+  world-size-change acceptance: an n=4 gang trains a deterministic
+  world-size-INDEPENDENT toy problem (replicated ``w``, axis-0-SHARDED
+  momentum ``m``, per-rank tag) with v2-manifest checkpoints, the whole
+  gang is preempted (self-SIGTERM at the same iteration, the shape of a
+  node drain), and a FRESH n=2 gang elastically resumes via
+  ``reshard_host`` and finishes — its per-step losses must match
+  ``elastic_base``'s uninterrupted n=2 run.
+
+Usage: python tests/_chaos_worker.py <n> <i> <port> <tmpdir> \
+           <crash|resume|preempt|elastic_train|elastic_resume|elastic_base> \
            <crash_at> <victim>
 """
 
@@ -26,26 +46,13 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 TOTAL_ITERS = 8
 
 
-def main():
-    n, i, port, tmpdir, phase = (int(sys.argv[1]), int(sys.argv[2]),
-                                 sys.argv[3], sys.argv[4], sys.argv[5])
-    crash_at, victim = int(sys.argv[6]), int(sys.argv[7])
-    import jax
-
-    jax.config.update("jax_platforms", "cpu")
-
+def run_crash_resume(n, i, tmpdir, phase, crash_at, victim, mn, comm):
+    """The original modes: raise mid-training, then same-world resume."""
     import numpy as np
 
-    import chainermn_tpu as mn
     from chainermn_tpu.extensions import (Watchdog,
                                           create_multi_node_checkpointer)
 
-    # Product-surface bootstrap: installs the global except hook too.
-    mn.init_distributed(coordinator_address=f"localhost:{port}",
-                        num_processes=n, process_id=i)
-    assert sys.excepthook.__name__ == "_global_except_hook", sys.excepthook
-
-    comm = mn.create_communicator("xla")
     rank = comm.rank
 
     # Survivors have nothing to raise when a peer dies — the watchdog is
@@ -81,6 +88,192 @@ def main():
     wd.finalize()
     cp.finalize()
     print(f"WORKER_OK {i}")
+
+
+def run_preempt(n, i, tmpdir, crash_at, victim, mn, comm):
+    """SIGTERM-preempt ONE victim mid-step (ISSUE 8 mode 1).
+
+    The victim self-delivers SIGTERM right before iteration ``crash_at``'s
+    collective — a real signal through the real handler, landing mid-step
+    by construction.  It must exit 0 with a final generation saved and a
+    ``preempt`` bundle.  The survivors' next object collective waits on a
+    KV key the victim will never publish; the hardened DCN lanes turn
+    that into bounded retries and a loud DcnLaneError naming the lane.
+    """
+    import signal
+
+    import numpy as np
+
+    from chainermn_tpu.extensions import create_multi_node_checkpointer
+    from chainermn_tpu.extensions.preemption import PreemptionHandler
+    from chainermn_tpu.observability import flight
+
+    rank = comm.rank
+    bundles = os.path.join(tmpdir, "bundles")
+    flight.set_crash_dump_dir(bundles)  # survivors' except-hook dump
+
+    # Default manifest=True on purpose: save()'s checksum exchange is
+    # BOUNDED and non-lockstep (allgather_obj_eventual), so the victim's
+    # final save completes even though its peers are mid-iteration, not
+    # preempting — the exact hazard a collective gather would wedge on.
+    cp = create_multi_node_checkpointer(
+        name="preempt", comm=comm, path=tmpdir, keep=10,
+        async_write=False)
+    handler = PreemptionHandler(cp, grace_s=20.0, dump_dir=bundles,
+                                rank=rank)
+    handler.install()
+
+    state = {"rank": rank, "w": np.zeros(4, np.float32)}
+    for it in range(TOTAL_ITERS):
+        if rank == victim and it == crash_at:
+            os.kill(os.getpid(), signal.SIGTERM)  # scheduler preemption
+            assert handler.requested  # flag only; work continues to the
+            #                           step boundary below
+        total = comm.allreduce_obj(it)
+        assert total == it * n
+        state["w"] = state["w"] + 1.0
+        cp.save(state, iteration=it)
+        handler.check(state, it)  # raises PreemptionExit(0) when flagged
+
+    print(f"WORKER_OK {i}")
+
+
+# ---- the elastic toy problem: world-size-INDEPENDENT by construction ----
+E_TOTAL = 8          # iterations of the elastic runs
+E_M = 8              # logical length of the sharded momentum vector
+E_BATCH = 16         # global batch, divisible by any world size used
+
+
+def _elastic_state(rank, n):
+    import numpy as np
+
+    block = E_M // n
+    return {
+        "m": np.zeros(block, np.float64),   # sharded axis 0
+        "rank_tag": rank,                   # per-rank
+        "w": np.float64(0.0),               # replicated
+    }
+
+
+def _elastic_layout(state):
+    """Dotted-path layout map for the v2 manifest, built the same way
+    the checkpointer keys it (jax.tree_util.keystr)."""
+    import jax
+
+    paths = [jax.tree_util.keystr(kp) for kp, _ in
+             jax.tree_util.tree_flatten_with_path(state)[0]]
+    m_key = next(p for p in paths if "'m'" in p)
+    tag_key = next(p for p in paths if "rank_tag" in p)
+    return {m_key: ["sharded", 0], tag_key: "per_rank"}
+
+
+def _elastic_step(state, it, rank, n, comm):
+    """One deterministic update.  Every quantity reduces over the FIXED
+    global batch/logical index space, so the trajectory is identical for
+    any world size (modulo float summation order — the test compares
+    allclose, not equal)."""
+    import math
+
+    # per-process contiguous slice of the fixed global batch
+    per = E_BATCH // n
+    lo = rank * per
+    partial = sum(
+        math.tanh(0.1 * float(state["w"]) + 0.01 * (((it * E_BATCH + j) % 7)
+                                                    - 3))
+        for j in range(lo, lo + per))
+    grad = comm.allreduce_obj(partial)          # world-size independent
+
+    # momentum is SHARDED: each rank updates its block by LOGICAL index,
+    # so the logical array evolves identically at any world size — and a
+    # botched elastic reshard of m would derail w (and the losses) below
+    block = E_M // n
+    base = rank * block
+    for k in range(block):
+        state["m"][k] = 0.9 * state["m"][k] + 0.1 * grad * (base + k + 1)
+    msum = comm.allreduce_obj(float(state["m"].sum()))
+    state["w"] = state["w"] - 0.01 * msum
+    return float(state["w"]) ** 2 + 0.001 * it  # the per-step "loss"
+
+
+def run_elastic(n, i, tmpdir, phase, preempt_at, mn, comm):
+    import signal
+
+    from chainermn_tpu.extensions import create_multi_node_checkpointer
+    from chainermn_tpu.extensions.preemption import PreemptionHandler
+    from chainermn_tpu.observability import flight
+
+    rank = comm.rank
+    state = _elastic_state(rank, n)
+    bundles = os.path.join(tmpdir, "bundles")
+
+    cp = None
+    handler = None
+    if phase != "elastic_base":
+        cp = create_multi_node_checkpointer(
+            name="elastic", comm=comm, path=tmpdir, keep=10,
+            async_write=True, layout=_elastic_layout(state))
+    if phase == "elastic_train":
+        flight.set_crash_dump_dir(bundles)
+        handler = PreemptionHandler(cp, grace_s=30.0, dump_dir=bundles,
+                                    rank=rank)
+        handler.install()
+
+    start = 0
+    if phase == "elastic_resume":
+        loaded, it_resumed = cp.maybe_load()
+        assert it_resumed == preempt_at, (it_resumed, preempt_at)
+        # per_rank leaf: new rank r inherited old rank r's value
+        assert loaded["rank_tag"] == rank % 4, loaded["rank_tag"]
+        assert loaded["m"].shape == (E_M // n,), loaded["m"].shape
+        state = loaded
+        state["rank_tag"] = rank
+        start = it_resumed + 1
+        print(f"RESUMED {it_resumed}")
+
+    for it in range(start, E_TOTAL):
+        loss = _elastic_step(state, it, rank, n, comm)
+        print(f"LOSS {it} {loss:.15e}", flush=True)
+        if cp is not None:
+            cp.save(state, iteration=it)
+        if phase == "elastic_train":
+            if it == preempt_at:
+                # the WHOLE gang is preempted at the same step (a node
+                # drain SIGTERMs every process) — self-delivery keeps the
+                # collective manifest gather in lockstep
+                os.kill(os.getpid(), signal.SIGTERM)
+            handler.check(state, it)  # exits 0 via PreemptionExit
+
+    assert phase != "elastic_train", "elastic_train must preempt before end"
+    if cp is not None:
+        cp.flush()  # keep shards: the test inspects them (no finalize)
+    print(f"WORKER_OK {i}")
+
+
+def main():
+    n, i, port, tmpdir, phase = (int(sys.argv[1]), int(sys.argv[2]),
+                                 sys.argv[3], sys.argv[4], sys.argv[5])
+    crash_at, victim = int(sys.argv[6]), int(sys.argv[7])
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import chainermn_tpu as mn
+
+    # Product-surface bootstrap: installs the global except hook too.
+    mn.init_distributed(coordinator_address=f"localhost:{port}",
+                        num_processes=n, process_id=i)
+    assert sys.excepthook.__name__ == "_global_except_hook", sys.excepthook
+
+    comm = mn.create_communicator("xla")
+
+    if phase in ("crash", "resume"):
+        run_crash_resume(n, i, tmpdir, phase, crash_at, victim, mn, comm)
+    elif phase == "preempt":
+        run_preempt(n, i, tmpdir, crash_at, victim, mn, comm)
+    elif phase in ("elastic_train", "elastic_resume", "elastic_base"):
+        run_elastic(n, i, tmpdir, phase, crash_at, mn, comm)
+    else:
+        raise ValueError(f"unknown phase {phase!r}")
 
 
 if __name__ == "__main__":

@@ -1,0 +1,48 @@
+"""Hardened-DCN-lane worker — run by tests/test_lanes.py.
+
+A 2-process jax.distributed gang exercises the object lane (KV-store
+transport on this container) under ``CHAINERMN_TPU_LANE_FAULT`` env
+injection:
+
+* ``transient`` faults must be absorbed by ``lane_call``'s backoff —
+  the collective completes and the worker prints the retry count it
+  observed in the flight ring;
+* a ``permanent`` fault must be a bounded LOUD death: DcnLaneError to
+  the except hook, an ``uncaught_exception`` bundle whose ring names
+  the lane, exit 1 — never a hang.
+
+Usage: python tests/_lane_worker.py <n> <i> <port> <tmpdir>
+(the fault spec rides in the environment, gang-uniform like production)
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    n, i, port, tmpdir = (int(sys.argv[1]), int(sys.argv[2]), sys.argv[3],
+                          sys.argv[4])
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import chainermn_tpu as mn
+    from chainermn_tpu.observability import flight
+
+    mn.init_distributed(coordinator_address=f"localhost:{port}",
+                        num_processes=n, process_id=i)
+    flight.set_crash_dump_dir(os.path.join(tmpdir, "bundles"))
+
+    comm = mn.create_communicator("xla")
+    out = comm.allgather_obj(("hello", i))
+    assert len(out) == n, out
+    ring = flight.get_flight_recorder().events()
+    retries = [ev for ev in ring if ev.get("kind") == "dcn_lane_retry"]
+    print(f"RETRIES {len(retries)}")
+    print(f"WORKER_OK {i}")
+
+
+if __name__ == "__main__":
+    main()

@@ -6,6 +6,7 @@ maybe_load round-trip, generation GC) and ``test_allreduce_persistent.py``
 the except hook's single-process passthrough.
 """
 
+import os
 import sys
 
 import numpy as np
@@ -70,8 +71,11 @@ class TestCheckpointer:
         # Simulate a restart with a different world size by renaming the
         # shard's world-size tag.
         import os
-        (old,) = [f for f in os.listdir(tmp_path) if not f.startswith(".")]
+        (old,) = [f for f in os.listdir(tmp_path)
+                  if not f.startswith(".") and "manifest" not in f]
         os.rename(tmp_path / old, tmp_path / old.replace("of1", "of4"))
+        # the stray world-4 shard has no world-4 manifest, so it is not
+        # elastically restorable either — still a loud collective error
         with pytest.raises(RuntimeError, match="world size"):
             cp.maybe_load()
 
@@ -415,8 +419,10 @@ class TestMultiNodeSnapshot:
         snap.save(self._state(8), iteration=8)
         snap.flush()  # saves ride the one-deep async writer
         import os
-        files = [f for f in os.listdir(tmp_path) if not f.startswith(".")]
+        files = [f for f in os.listdir(tmp_path)
+                 if not f.startswith(".") and "manifest" not in f]
         # 2 replica sets x 2 generations — NOT comm.size shards per gen
+        # (plus one v2 manifest sidecar per generation, filtered above)
         assert len(files) == 4, files
         assert all(".set" in f and f"of2" in f for f in files)
         loaded, it = snap.maybe_load()
@@ -433,7 +439,8 @@ class TestMultiNodeSnapshot:
         snap.save(self._state(1), iteration=1)
         snap.flush()  # saves ride the one-deep async writer
         import os
-        files = [f for f in os.listdir(tmp_path) if not f.startswith(".")]
+        files = [f for f in os.listdir(tmp_path)
+                 if not f.startswith(".") and "manifest" not in f]
         assert len(files) == comm.size - 1
 
     def test_overlapping_sets_rejected(self, comm, tmp_path):
@@ -482,3 +489,315 @@ class TestMultiNodeSnapshot:
         snap.flush()
         loaded, it = snap.maybe_load()
         assert it == 2 and loaded["step"] == 2
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 8: format-v2 manifests, torn-shard tolerance, elastic resume,
+# bounded-grace preemption
+# ---------------------------------------------------------------------------
+
+class TestManifestV2:
+    """Per-generation manifest: schema, layout, logical shapes, CRCs."""
+
+    def _state(self, step):
+        return {"w": np.full((2, 2), float(step)), "step": step}
+
+    def test_manifest_written_and_checksums_match(self, comm, tmp_path):
+        import json
+        import zlib
+
+        cp = create_multi_node_checkpointer("job", comm, path=str(tmp_path))
+        cp.save(self._state(4), iteration=4)
+        cp.flush()
+        man_path = cp._manifest_path(4)
+        assert os.path.exists(man_path)
+        with open(man_path) as f:
+            man = json.load(f)
+        from chainermn_tpu.extensions import MANIFEST_SCHEMA
+        assert man["schema"] == MANIFEST_SCHEMA
+        assert man["world_size"] == 1
+        shard = open(cp._filename(4), "rb").read()
+        assert man["checksums"]["0"] == zlib.crc32(shard) & 0xFFFFFFFF
+        # logical leaf shapes recorded (all replicated here)
+        shapes = sorted(tuple(l["shape"]) for l in man["leaves"])
+        assert shapes == [(), (2, 2)]
+
+    def test_torn_shard_falls_back_to_previous_generation(self, comm,
+                                                          tmp_path):
+        """A truncated shard (death mid-write) is excluded by its CRC —
+        resume lands on the previous consistent generation instead of
+        unpickling garbage."""
+        cp = create_multi_node_checkpointer("job", comm, path=str(tmp_path))
+        cp.save(self._state(1), iteration=1)
+        cp.save(self._state(2), iteration=2)
+        cp.flush()
+        shard2 = cp._filename(2)
+        data = open(shard2, "rb").read()
+        with open(shard2, "wb") as f:
+            f.write(data[: len(data) // 2])  # torn write
+        loaded, it = cp.maybe_load()
+        assert it == 1
+        np.testing.assert_array_equal(loaded["w"], np.full((2, 2), 1.0))
+
+    def test_torn_only_generation_raises_loudly(self, comm, tmp_path):
+        cp = create_multi_node_checkpointer("job", comm, path=str(tmp_path))
+        cp.save(self._state(1), iteration=1)
+        cp.flush()
+        with open(cp._filename(1), "ab") as f:
+            f.write(b"garbage appended after the atomic rename")
+        with pytest.raises(RuntimeError, match="torn|restorable"):
+            cp.maybe_load()
+
+    def test_manifest_false_keeps_v1_behavior(self, comm, tmp_path):
+        cp = create_multi_node_checkpointer("job", comm, path=str(tmp_path),
+                                            manifest=False)
+        cp.save(self._state(3), iteration=3)
+        cp.flush()
+        assert not os.path.exists(cp._manifest_path(3))
+        assert cp.maybe_load()[1] == 3
+
+    def test_writer_error_reraises_at_next_save(self, comm, tmp_path):
+        """The async save thread's failure must surface at the NEXT
+        checkpoint call, never vanish (ISSUE 8 satellite)."""
+        cp = create_multi_node_checkpointer("job", comm, path=str(tmp_path))
+        cp.save(self._state(1), iteration=1)
+        cp.flush()
+        cp._submit(lambda: (_ for _ in ()).throw(OSError("disk gone")))
+        with pytest.raises(OSError, match="disk gone"):
+            cp.save(self._state(2), iteration=2)
+        # the checkpointer stays usable afterwards
+        cp.save(self._state(3), iteration=3)
+        assert cp.maybe_load()[1] == 3
+
+
+class TestElasticResume:
+    """maybe_load on a DIFFERENT process count: shards re-partitioned
+    host-side per the manifest layout (reshard_host)."""
+
+    def _old_world(self, tmp_path, old_n, iteration, name="job",
+                   sharded_len=8):
+        """Write a complete old-world generation + v2 manifest by hand:
+        replicated w, axis-0-sharded m, per_rank rank_tag."""
+        import json
+        import pickle
+        import zlib
+
+        import jax
+
+        from chainermn_tpu.extensions.checkpoint import (
+            MANIFEST_SCHEMA, _leaf_paths_and_shapes)
+
+        full_m = np.arange(sharded_len, dtype=np.float32)
+        block = sharded_len // old_n
+        checksums = {}
+        state0 = None
+        for p in range(old_n):
+            state = {"m": full_m[p * block:(p + 1) * block],
+                     "rank_tag": p,
+                     "w": np.full((2, 2), 7.0)}
+            state0 = state0 or state
+            payload = pickle.dumps(state, protocol=pickle.HIGHEST_PROTOCOL)
+            fn = tmp_path / f"{name}.iter{iteration:012d}.proc{p}of{old_n}"
+            fn.write_bytes(payload)
+            checksums[str(p)] = zlib.crc32(payload) & 0xFFFFFFFF
+        # layout keyed by keystr dotted paths, like the checkpointer writes
+        paths = [jax.tree_util.keystr(kp) for kp, _ in
+                 jax.tree_util.tree_flatten_with_path(state0)[0]]
+        m_key = next(p for p in paths if "m" in p and "rank" not in p)
+        tag_key = next(p for p in paths if "rank_tag" in p)
+        layout = {m_key: ["sharded", 0], tag_key: "per_rank"}
+        man = {"schema": MANIFEST_SCHEMA, "name": name,
+               "iteration": iteration, "world_size": old_n, "kind": "proc",
+               "layout": layout,
+               "leaves": _leaf_paths_and_shapes(state0, layout, old_n),
+               "checksums": checksums}
+        (tmp_path / f"{name}.iter{iteration:012d}.world{old_n}"
+         ".manifest.json").write_text(json.dumps(man))
+        return full_m
+
+    def test_resume_from_larger_world(self, comm, tmp_path):
+        full_m = self._old_world(tmp_path, old_n=2, iteration=6)
+        cp = create_multi_node_checkpointer("job", comm, path=str(tmp_path))
+        loaded, it = cp.maybe_load()
+        assert it == 6
+        np.testing.assert_array_equal(loaded["w"], np.full((2, 2), 7.0))
+        # world 1 holds the WHOLE re-concatenated sharded leaf
+        np.testing.assert_array_equal(loaded["m"], full_m)
+        assert loaded["rank_tag"] == 0  # new rank 0 inherits old rank 0
+
+    def test_newer_elastic_generation_beats_same_world(self, comm,
+                                                       tmp_path):
+        cp = create_multi_node_checkpointer("job", comm, path=str(tmp_path))
+        cp.save({"m": np.zeros(8, np.float32), "rank_tag": 0,
+                 "w": np.full((2, 2), 1.0)}, iteration=3)
+        cp.flush()
+        self._old_world(tmp_path, old_n=2, iteration=9)
+        loaded, it = cp.maybe_load()
+        assert it == 9
+        np.testing.assert_array_equal(loaded["w"], np.full((2, 2), 7.0))
+
+    def test_same_world_wins_when_newer(self, comm, tmp_path):
+        self._old_world(tmp_path, old_n=2, iteration=3)
+        cp = create_multi_node_checkpointer("job", comm, path=str(tmp_path))
+        cp.save({"m": np.zeros(8, np.float32), "rank_tag": 0,
+                 "w": np.full((2, 2), 1.0)}, iteration=5)
+        cp.flush()
+        loaded, it = cp.maybe_load()
+        assert it == 5
+        np.testing.assert_array_equal(loaded["w"], np.full((2, 2), 1.0))
+
+    def test_torn_old_world_shard_disqualifies_generation(self, comm,
+                                                          tmp_path):
+        self._old_world(tmp_path, old_n=2, iteration=6)
+        shard = tmp_path / "job.iter000000000006.proc1of2"
+        shard.write_bytes(shard.read_bytes()[:10])  # torn
+        cp = create_multi_node_checkpointer("job", comm, path=str(tmp_path))
+        with pytest.raises(RuntimeError, match="restorable"):
+            cp.maybe_load()
+
+    def test_elastic_false_ignores_other_worlds(self, comm, tmp_path):
+        self._old_world(tmp_path, old_n=2, iteration=6)
+        cp = create_multi_node_checkpointer("job", comm, path=str(tmp_path))
+        with pytest.raises(RuntimeError, match="world size"):
+            cp.maybe_load(elastic=False)
+
+    def test_gc_reaps_old_world_after_elastic_resume(self, comm, tmp_path):
+        """Old-world shards have no owning process in the new world —
+        without the other-world sweep an n=2→n=1 resume would leak
+        proc1of2 (and the world2 manifest) forever."""
+        self._old_world(tmp_path, old_n=2, iteration=6)
+        cp = create_multi_node_checkpointer(
+            "job", comm, gc_interval=1, path=str(tmp_path))
+        loaded, it = cp.maybe_load()
+        assert it == 6
+        cp.save({"m": np.zeros(8, np.float32), "rank_tag": 0,
+                 "w": np.full((2, 2), 1.0)}, iteration=7)
+        cp.flush()
+        left = sorted(os.listdir(tmp_path))
+        assert not any("of2" in f or "world2" in f for f in left), left
+        assert cp.maybe_load()[1] == 7  # new-world generation survives
+
+
+class TestPreemptionHandler:
+    """SIGTERM → flag → step-boundary save → bundle → exit 0, bounded by
+    the grace deadline."""
+
+    def _handler(self, tmp_path, comm=None, grace_s=30.0, **kw):
+        import signal as _signal
+
+        from chainermn_tpu.extensions.preemption import PreemptionHandler
+
+        exits = []
+        h = PreemptionHandler(
+            create_multi_node_checkpointer(
+                "job", comm, path=str(tmp_path / "ckpt"))
+            if comm is not None else None,
+            grace_s=grace_s, dump_dir=str(tmp_path / "dump"),
+            exit_fn=exits.append, **kw)
+        return h, exits, _signal
+
+    def test_signal_sets_flag_only(self, comm, tmp_path):
+        h, exits, signal = self._handler(tmp_path, comm)
+        assert not h.requested
+        h._on_signal(signal.SIGTERM, None)
+        assert h.requested and not h.completed
+        assert exits == []  # nothing exits until a step boundary
+
+    def test_finish_saves_books_dumps_and_exits_zero(self, comm, tmp_path):
+        from chainermn_tpu.extensions.preemption import PreemptionExit
+        from chainermn_tpu.observability.flight import read_bundle
+        from chainermn_tpu.observability.slo import GoodputLedger
+
+        ledger = GoodputLedger()
+        h, exits, signal = self._handler(tmp_path, comm, ledger=ledger)
+        h._on_signal(signal.SIGTERM, None)
+        state = {"w": np.arange(4.0)}
+        with pytest.raises(PreemptionExit) as ei:
+            h.check(state, iteration=11)
+        assert ei.value.code == 0
+        assert ei.value.generation == 11
+        assert h.completed
+        # the final generation is on disk and resumable
+        loaded, it = h.checkpointer.maybe_load()
+        assert it == 11
+        np.testing.assert_array_equal(loaded["w"], np.arange(4.0))
+        # save overhead booked, not vanished
+        assert ledger.buckets()["checkpoint"] > 0
+        # the preempt bundle names signal, grace, generation
+        bundles = os.listdir(tmp_path / "dump")
+        assert len(bundles) == 1 and "-preempt" in bundles[0]
+        bundle = read_bundle(str(tmp_path / "dump" / bundles[0]))
+        extra = bundle["manifest"]["extra"]["preempt"]
+        assert extra["signal"] == "SIGTERM"
+        assert extra["generation_saved"] == 11
+        assert extra["why_not_saved"] is None
+        assert extra["grace_used_s"] <= h.grace_s
+        assert "resume" in extra["resume_hint"]
+
+    def test_grace_deadline_bounds_a_wedged_step(self, comm, tmp_path):
+        """No step boundary inside the grace window: the watchdog thread
+        dumps a bundle explaining why nothing was saved and exits 0."""
+        import time as _time
+
+        from chainermn_tpu.observability.flight import read_bundle
+
+        h, exits, signal = self._handler(tmp_path, comm, grace_s=0.3)
+        h._on_signal(signal.SIGTERM, None)
+        deadline = _time.monotonic() + 5.0
+        while not exits and _time.monotonic() < deadline:
+            _time.sleep(0.02)
+        assert exits == [0], "deadline thread must exit 0, bounded"
+        bundles = os.listdir(tmp_path / "dump")
+        assert len(bundles) == 1
+        extra = read_bundle(
+            str(tmp_path / "dump" / bundles[0]))["manifest"]["extra"]
+        assert "grace budget exhausted" in extra["preempt"]["why_not_saved"]
+        assert extra["preempt"]["generation_saved"] is None
+
+    def test_no_checkpointer_still_bounded_exit_zero(self, tmp_path):
+        from chainermn_tpu.extensions.preemption import (PreemptionExit,
+                                                         PreemptionHandler)
+
+        exits = []
+        h = PreemptionHandler(None, grace_s=5.0,
+                              dump_dir=str(tmp_path / "dump"),
+                              exit_fn=exits.append)
+        import signal
+        h._on_signal(signal.SIGTERM, None)
+        with pytest.raises(PreemptionExit) as ei:
+            h.check({"x": 1}, iteration=2)
+        assert ei.value.code == 0 and ei.value.generation is None
+
+    def test_save_failure_still_exits_zero_with_reason(self, comm,
+                                                       tmp_path):
+        from chainermn_tpu.extensions.preemption import PreemptionExit
+        from chainermn_tpu.observability.flight import read_bundle
+
+        h, exits, signal = self._handler(tmp_path, comm)
+        h._on_signal(signal.SIGTERM, None)
+        with pytest.raises(PreemptionExit) as ei:
+            h.check({"bad": lambda: None}, iteration=4)  # unpicklable
+        assert ei.value.code == 0 and ei.value.generation is None
+        bundles = os.listdir(tmp_path / "dump")
+        extra = read_bundle(
+            str(tmp_path / "dump" / bundles[0]))["manifest"]["extra"]
+        assert "save failed" in extra["preempt"]["why_not_saved"]
+
+    def test_rejects_nonpositive_grace(self):
+        from chainermn_tpu.extensions.preemption import PreemptionHandler
+
+        with pytest.raises(ValueError, match="grace_s"):
+            PreemptionHandler(None, grace_s=0)
+
+    def test_install_uninstall_restores_disposition(self, tmp_path):
+        import signal
+
+        from chainermn_tpu.extensions.preemption import PreemptionHandler
+
+        prev = signal.getsignal(signal.SIGTERM)
+        h = PreemptionHandler(None, dump_dir=str(tmp_path))
+        h.install()
+        assert signal.getsignal(signal.SIGTERM) == h._on_signal
+        h.install()  # idempotent
+        h.uninstall()
+        assert signal.getsignal(signal.SIGTERM) == prev

@@ -1,0 +1,257 @@
+"""Hardened DCN lanes (communicators/base.py, ISSUE 8).
+
+The object-transport side channels (allgather_obj / bcast_obj / KV
+store) ride ``lane_call``: a TRANSIENT fault backs off exponentially
+and retries (asserted retry counts, both in-process and across a real
+2-process gang); a PERMANENT fault — or exhausted retries — raises
+:class:`DcnLaneError` with the lane NAMED, and in a gang that means a
+bounded loud death with a flight bundle whose ring names the lane.
+Classification is deterministic on error TEXT so every rank makes the
+same retry-vs-die call.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+from chainermn_tpu.communicators.base import (
+    DcnLaneError,
+    LaneConfig,
+    TRANSIENT_LANE_PATTERNS,
+    classify_lane_error,
+    lane_call,
+    set_lane_fault_injector,
+)
+from chainermn_tpu.observability import flight
+
+_WORKER = os.path.join(os.path.dirname(__file__), "_lane_worker.py")
+
+
+@pytest.fixture(autouse=True)
+def _clean_injector():
+    set_lane_fault_injector(None)
+    flight.get_flight_recorder().clear()
+    yield
+    set_lane_fault_injector(None)
+
+
+def _cfg(**kw):
+    kw.setdefault("max_retries", 3)
+    kw.setdefault("backoff_base_s", 0.001)
+    kw.setdefault("backoff_max_s", 0.004)
+    return LaneConfig(**kw)
+
+
+class TestClassification:
+    @pytest.mark.parametrize("msg", TRANSIENT_LANE_PATTERNS)
+    def test_transient_patterns(self, msg):
+        assert classify_lane_error(RuntimeError(f"xx {msg} yy")) == \
+            "transient"
+
+    def test_case_insensitive(self):
+        assert classify_lane_error(
+            RuntimeError("DEADLINE_EXCEEDED: kv get")) == "transient"
+        assert classify_lane_error(
+            RuntimeError("UNAVAILABLE: coordinator")) == "transient"
+
+    def test_unknown_is_permanent(self):
+        """Anything unrecognized must NOT be retried — a desynced retry
+        could split the gang's lane sequence numbers."""
+        assert classify_lane_error(ValueError("corrupt payload")) == \
+            "permanent"
+
+
+class TestLaneCall:
+    def test_transient_fault_recovers_via_backoff(self):
+        """The acceptance shape: an injected transient fault recovers,
+        with the retry COUNT asserted (and each retry in the ring)."""
+        calls = []
+
+        def injector(lane, attempt):
+            calls.append(attempt)
+            if attempt < 2:
+                raise RuntimeError("injected transient lane fault")
+
+        set_lane_fault_injector(injector)
+        out = lane_call("kv_store/get/test", lambda: "payload", _cfg())
+        assert out == "payload"
+        assert calls == [0, 1, 2]  # two faults absorbed, third attempt ok
+        retries = [ev for ev in flight.get_flight_recorder().events()
+                   if ev["kind"] == "dcn_lane_retry"]
+        assert len(retries) == 2
+        assert all(r["lane"] == "kv_store/get/test" for r in retries)
+        # exponential: second backoff doubles the first
+        assert retries[1]["backoff_s"] == pytest.approx(
+            2 * retries[0]["backoff_s"])
+
+    def test_transient_fault_exhausts_retries_loudly(self):
+        def injector(lane, attempt):
+            raise RuntimeError("connection reset by peer")
+
+        set_lane_fault_injector(injector)
+        with pytest.raises(DcnLaneError) as ei:
+            lane_call("kv_store/get/test", lambda: None, _cfg())
+        assert ei.value.attempts == 4  # 1 + max_retries
+        assert ei.value.lane == "kv_store/get/test"
+        fault = flight.get_flight_recorder().last("dcn_lane_fault")
+        assert fault["lane"] == "kv_store/get/test"
+        assert fault["classification"] == "transient"
+
+    def test_permanent_fault_dies_immediately(self):
+        attempts = []
+
+        def injector(lane, attempt):
+            attempts.append(attempt)
+            raise RuntimeError("assertion failed: corrupt frame")
+
+        set_lane_fault_injector(injector)
+        with pytest.raises(DcnLaneError) as ei:
+            lane_call("kv_store/set/x", lambda: None, _cfg())
+        assert attempts == [0]  # NO retry of an unclassified fault
+        assert ei.value.attempts == 1
+        assert "kv_store/set/x" in str(ei.value)
+        fault = flight.get_flight_recorder().last("dcn_lane_fault")
+        assert fault["classification"] == "permanent"
+
+    def test_backoff_caps_at_max(self):
+        def injector(lane, attempt):
+            raise RuntimeError("timed out")
+
+        set_lane_fault_injector(injector)
+        with pytest.raises(DcnLaneError):
+            lane_call("lane", lambda: None,
+                      _cfg(max_retries=4, backoff_base_s=0.001,
+                           backoff_max_s=0.002))
+        retries = [ev for ev in flight.get_flight_recorder().events()
+                   if ev["kind"] == "dcn_lane_retry"]
+        assert [r["backoff_s"] for r in retries] == \
+            [0.001, 0.002, 0.002, 0.002]
+
+    def test_env_fault_injector(self, monkeypatch):
+        """The subprocess-gang face: CHAINERMN_TPU_LANE_FAULT arms a
+        counted injector matched by lane substring."""
+        import chainermn_tpu.communicators.base as base
+
+        monkeypatch.setenv("CHAINERMN_TPU_LANE_FAULT",
+                           "kv_store:transient:2")
+        monkeypatch.setattr(base, "_ENV_FAULT", None)
+        cfg = _cfg()
+        assert lane_call("kv_store/get/a", lambda: 1, cfg) == 1  # 2 retries
+        retries = [ev for ev in flight.get_flight_recorder().events()
+                   if ev["kind"] == "dcn_lane_retry"]
+        assert len(retries) == 2
+        # the budget is spent: further calls are clean
+        assert lane_call("kv_store/get/a", lambda: 2, cfg) == 2
+        assert len([ev for ev in flight.get_flight_recorder().events()
+                    if ev["kind"] == "dcn_lane_retry"]) == 2
+        # non-matching lanes never see the injector
+        monkeypatch.setattr(base, "_ENV_FAULT", None)
+        monkeypatch.setenv("CHAINERMN_TPU_LANE_FAULT",
+                           "kv_store:permanent:1")
+        assert lane_call("other_lane", lambda: 3, cfg) == 3
+
+    def test_dcn_lane_error_never_reclassified(self):
+        """A DcnLaneError from a nested lane_call propagates untouched
+        (no double-wrapping, no retry of an already-final verdict)."""
+        inner = DcnLaneError("kv_store/get/y", 3, RuntimeError("x"))
+
+        def thunk():
+            raise inner
+
+        with pytest.raises(DcnLaneError) as ei:
+            lane_call("outer", thunk, _cfg())
+        assert ei.value is inner
+
+
+class TestLaneConfigEnv:
+    def test_env_overrides(self, monkeypatch):
+        monkeypatch.setenv("CHAINERMN_TPU_LANE_RETRIES", "7")
+        monkeypatch.setenv("CHAINERMN_TPU_LANE_BACKOFF_S", "0.5")
+        monkeypatch.setenv("CHAINERMN_TPU_LANE_BACKOFF_MAX_S", "9.0")
+        monkeypatch.setenv("CHAINERMN_TPU_LANE_TIMEOUT_MS", "1234")
+        cfg = LaneConfig()
+        assert cfg.max_retries == 7
+        assert cfg.backoff_base_s == 0.5
+        assert cfg.backoff_max_s == 9.0
+        assert cfg.timeout_ms == 1234
+
+    def test_explicit_args_beat_env(self, monkeypatch):
+        monkeypatch.setenv("CHAINERMN_TPU_LANE_RETRIES", "7")
+        assert LaneConfig(max_retries=2).max_retries == 2
+
+
+# ---------------------------------------------------------------------------
+# real 2-process gangs under env fault injection
+# ---------------------------------------------------------------------------
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def _run_gang(tmpdir: str, fault: str = None):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = " ".join(
+        f for f in env.get("XLA_FLAGS", "").split()
+        if "host_platform_device_count" not in f)
+    env["CHAINERMN_TPU_LANE_BACKOFF_S"] = "0.01"
+    if fault:
+        env["CHAINERMN_TPU_LANE_FAULT"] = fault
+    port = _free_port()
+    procs = [subprocess.Popen(
+        [sys.executable, _WORKER, "2", str(i), str(port), tmpdir],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env) for i in range(2)]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=180)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise AssertionError("lane gang hung — death must be bounded")
+        outs.append(out)
+    return procs, outs
+
+
+@pytest.mark.slow
+def test_gang_transient_lane_fault_recovers(tmp_path):
+    """A transient KV-lane fault on a REAL gang's object collective is
+    absorbed by backoff — the collective completes, retry count on
+    record."""
+    procs, outs = _run_gang(str(tmp_path), fault="kv_store:transient:2")
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {i}:\n{out[-3000:]}"
+        assert f"WORKER_OK {i}" in out
+        assert "RETRIES 2" in out, out[-2000:]
+
+
+@pytest.mark.slow
+def test_gang_permanent_lane_fault_dies_loudly_with_bundle(tmp_path):
+    """The acceptance shape: an injected PERMANENT lane fault is a
+    bounded loud death — DcnLaneError to the except hook, exit 1, and a
+    flight bundle whose ring names the lane."""
+    procs, outs = _run_gang(str(tmp_path), fault="kv_store:permanent:1")
+    died = [i for i, p in enumerate(procs) if p.returncode != 0]
+    assert died, "at least the injected process must die loudly"
+    for i in died:
+        assert procs[i].returncode == 1, outs[i][-3000:]
+        assert "DCN lane" in outs[i], outs[i][-3000:]
+        assert "injected permanent lane fault" in outs[i], outs[i][-2000:]
+        assert f"WORKER_OK {i}" not in outs[i]
+    # the bundle names the lane
+    bundles_dir = tmp_path / "bundles"
+    bundles = [b for b in os.listdir(bundles_dir)
+               if "uncaught_exception" in b]
+    assert bundles, os.listdir(bundles_dir)
+    from chainermn_tpu.observability.flight import read_bundle
+    ring = read_bundle(str(bundles_dir / bundles[0]))["flight"]
+    faults = [ev for ev in ring if ev.get("kind") == "dcn_lane_fault"]
+    assert faults and "kv_store" in faults[0]["lane"]
+    assert faults[0]["classification"] == "permanent"

@@ -204,3 +204,86 @@ class TestTrainerResume:
         # The resumed run's epoch-2 loss must match the straight run's.
         assert log2.log[-1]["main/loss"] == pytest.approx(
             log_full.log[-1]["main/loss"], rel=1e-4)
+
+
+class TestPrefetchUpdater:
+    """Double-buffered input prefetch (ISSUE 8 / ROADMAP 5a): the
+    background pipeline must be invisible — same batch stream, same
+    epoch bookkeeping, same checkpointed iterator state as the
+    synchronous path — and assembly errors must surface in update()."""
+
+    def _updater(self, prefetch, seen):
+        ds = make_dataset(48)
+
+        def step_fn(state, batch):
+            x, y = batch
+            seen.append(float(np.asarray(x).sum()))
+            return state + 1, {"n": state}
+
+        return StandardUpdater(SerialIterator(ds, 8, seed=3), step_fn, 0,
+                               shard=False, prefetch=prefetch)
+
+    def test_same_batch_stream_and_epoch_bookkeeping(self):
+        seen_sync, seen_pre = [], []
+        upd_s = self._updater(False, seen_sync)
+        upd_p = self._updater(True, seen_pre)
+        marks_s, marks_p = [], []
+        for _ in range(13):  # 6 steps/epoch: crosses two epoch turns
+            upd_s.update()
+            upd_p.update()
+            marks_s.append((upd_s.epoch, upd_s.is_new_epoch,
+                            upd_s.epoch_detail))
+            marks_p.append((upd_p.epoch, upd_p.is_new_epoch,
+                            upd_p.epoch_detail))
+        upd_p.close()
+        # identical batches in identical order, even though the live
+        # iterator ran ahead of the consumed batch the whole time
+        assert seen_pre == seen_sync
+        # epoch/is_new_epoch/epoch_detail reflect the CONSUMED batch
+        assert marks_p == marks_s
+
+    def test_state_dict_is_consumed_batch_snapshot(self):
+        """The checkpointed iterator state must replay the batches the
+        steps never saw — not the live iterator's run-ahead cursor."""
+        a, b = [], []
+        upd_s = self._updater(False, a)
+        upd_p = self._updater(True, b)
+        for _ in range(4):
+            upd_s.update()
+            upd_p.update()
+        sd_s = upd_s.state_dict()
+        sd_p = upd_p.state_dict()
+        upd_p.close()
+        ds = make_dataset(48)
+        it_s = SerialIterator(ds, 8, seed=3)
+        it_p = SerialIterator(ds, 8, seed=3)
+        it_s.load_state_dict(sd_s["iterator"])
+        it_p.load_state_dict(sd_p["iterator"])
+        for _ in range(3):  # both resumes yield the same following batches
+            bs, bp = it_s.next(), it_p.next()
+            np.testing.assert_array_equal(
+                np.stack([x for x, _ in bs]), np.stack([x for x, _ in bp]))
+
+    def test_assembly_error_reraises_in_update(self):
+        class Boom:
+            def __init__(self):
+                self.n = 0
+
+            def next(self):
+                self.n += 1
+                if self.n > 2:
+                    raise RuntimeError("converter exploded")
+                return [(np.zeros(3, np.float32), np.int32(0))]
+
+        upd = StandardUpdater(Boom(), lambda s, b: (s, {}), 0,
+                              shard=False, prefetch=True)
+        upd.update()  # batch 1 consumed; the thread hits the error
+        upd.update()  # batch 2 (already assembled) still delivers
+        with pytest.raises(RuntimeError, match="converter exploded"):
+            upd.update()
+        # the error is LATCHED: the worker thread is gone, so a caller
+        # that swallowed the first raise must get it again, not hang on
+        # an empty queue
+        with pytest.raises(RuntimeError, match="converter exploded"):
+            upd.update()
+        upd.close()
