@@ -805,6 +805,154 @@ def bench_serving_router():
     }
 
 
+def bench_serving_disagg():
+    """Disaggregated prefill/decode perf (ISSUE 9, docs/SERVING.md
+    "Disaggregated prefill/decode"): the SAME offered load pushed
+    through the fused single engine and through 1:1 and 2:1 P:D
+    disaggregated fleets — per point the decode tick-GAP p50/p99 +
+    variance (the inter-token latency a decoding request actually
+    experiences; a prefill between ticks inflates it), TTFT p50/p99,
+    fleet tokens/s, and the transfer plane's wall (p50/p99 ms).
+
+    Offered load is wall-clock (one submit every few ms from the
+    driver) and every service runs its own background driver —
+    role-PARALLEL for the fleets (``DisaggRouter.start()``: one thread
+    per role), which is where moving prefill off the decode workers
+    becomes observable: the acceptance contract is disagg decode
+    ``tick_gap_p99 / tick_gap_p50`` strictly below the fused engine's,
+    with each point's goodput queue-wait/compute split as evidence.
+    Direction under the regression gate: ``*_ms``/``gap``/``variance``/
+    ``transfer`` keys lower-is-better (scripts/check_perf_regression
+    .py), throughput higher.
+    """
+    import jax
+    import numpy as np
+
+    import chainermn_tpu as mn
+    from chainermn_tpu.parallel import init_tp_transformer_lm
+    from chainermn_tpu.serving import (AdmissionError, ServingEngine,
+                                       build_disagg_fleet)
+
+    vocab, d_model, n_heads, n_layers = 128, 32, 4, 2
+    n_slots, n_requests, s_p, new = 4, 16, 32, 16
+    submit_every_s = 0.012
+    params = init_tp_transformer_lm(
+        jax.random.PRNGKey(0), vocab, d_model, n_heads, n_layers,
+        max_len=s_p + new, pos_impl="rope")
+    mesh = mn.make_nd_mesh(("model",), (1,), jax.devices()[:1])
+    rs = np.random.RandomState(0)
+    prompts = [rs.randint(0, vocab, s_p).astype(np.int32)
+               for _ in range(n_requests)]
+
+    def drive(service, submit, drained):
+        """Fixed wall-clock offered load against a started service."""
+        service.start()
+        handles, shed = [], 0
+        for p in prompts:
+            try:
+                handles.append(submit(p))
+            except AdmissionError:
+                shed += 1
+            time.sleep(submit_every_s)
+        t0 = time.time()
+        while not drained() and time.time() - t0 < 120:
+            time.sleep(0.005)
+        service.stop()
+        return handles, shed
+
+    def point_row(m, prefix, shed, goodput):
+        gp = {k.rsplit("/", 1)[-1]: v for k, v in goodput.items()}
+        return {
+            "tick_gap_p50_ms": round(m.get(f"{prefix}_p50_ms", 0.0), 3),
+            "tick_gap_p99_ms": round(m.get(f"{prefix}_p99_ms", 0.0), 3),
+            "tick_gap_p99_over_p50": round(
+                m.get(f"{prefix}_p99_ms", 0.0)
+                / max(m.get(f"{prefix}_p50_ms", 1e-9), 1e-9), 3),
+            "tick_gap_variance_ms2": round(
+                m.get(f"{prefix}_variance_ms2", 0.0), 4),
+            "shed": shed,
+            "goodput_queue_wait_s": round(gp.get("queue_wait_s", 0.0), 4),
+            "goodput_compute_s": round(gp.get("compute_s", 0.0), 4),
+        }
+
+    def run_fused():
+        eng = ServingEngine(params, head_dim=d_model // n_heads,
+                            n_slots=n_slots, max_total=s_p + new,
+                            mesh=mesh, queue_capacity=n_requests)
+        # warm prefill+tick compiles outside the measured window
+        h = eng.submit(prompts[0], 2)
+        eng.run(steps_budget=4)
+        assert h.status == "done", h.status
+        eng.reset_stats()
+        handles, shed = drive(
+            eng, lambda p: eng.submit(p, new),
+            lambda: eng.pool.busy_count == 0
+            and eng.scheduler.queue_depth == 0)
+        m = eng.metrics()
+        row = point_row(m, "serving/tick_gap", shed,
+                        {k: v for k, v in m.items() if "goodput" in k})
+        row.update({
+            "tokens_per_sec": round(m["serving/tokens_per_sec"], 1),
+            "ttft_p50_ms": round(m.get("serving/ttft_p50_ms", 0.0), 2),
+            "ttft_p99_ms": round(m.get("serving/ttft_p99_ms", 0.0), 2),
+            "done": sum(h.status == "done" for h in handles),
+        })
+        eng.close()
+        return row
+
+    def run_disagg(n_p, n_d):
+        fleet = build_disagg_fleet(
+            params, n_p, n_d, head_dim=d_model // n_heads,
+            max_total=s_p + new, n_slots=n_slots, staging_slots=2,
+            mesh=mesh, queue_capacity=n_requests,
+            transport_mode="local")
+        # warm EVERY worker's compiles (prefill + tick + transfer): the
+        # least-loaded dispatch spreads one warm request per prefill
+        # worker (each owns its own prefill-program family)
+        warm = [fleet.submit(prompts[0], 2) for _ in range(n_p)]
+        fleet.run(steps_budget=60)
+        assert all(h.status == "done" for h in warm), \
+            [(h.status, h.finish_reason) for h in warm]
+        fleet.reset_stats()
+        handles, shed = drive(
+            fleet, lambda p: fleet.submit(p, new),
+            lambda: all(w.idle for w in fleet.prefill_workers)
+            and all(dw.idle for dw in fleet.decode_workers))
+        m = fleet.metrics()
+        # the decode-side goodput split (queue-wait/compute evidence)
+        gp = {}
+        for dw in fleet.decode_workers:
+            for k, v in dw.engine.goodput.buckets().items():
+                gp[f"goodput/{k}_s"] = gp.get(f"goodput/{k}_s", 0.0) + v
+        row = point_row(m, "disagg/decode_tick_gap", shed, gp)
+        row.update({
+            "tokens_per_sec": round(m["disagg/fleet_tokens_per_sec"], 1),
+            "ttft_p50_ms": round(m.get("disagg/fleet_ttft_p50_ms", 0.0),
+                                 2),
+            "ttft_p99_ms": round(m.get("disagg/fleet_ttft_p99_ms", 0.0),
+                                 2),
+            "transfer_p50_ms": round(m.get("disagg/transfer_p50_ms",
+                                           0.0), 3),
+            "transfer_p99_ms": round(m.get("disagg/transfer_p99_ms",
+                                           0.0), 3),
+            "transfers": m["disagg/transfers_total"],
+            "requeued": m["disagg/requeued_total"],
+            "done": sum(h.status == "done" for h in handles),
+        })
+        fleet.close()
+        return row
+
+    return {
+        "config": f"d{d_model} L{n_layers} h{n_heads} V{vocab} "
+                  f"slots{n_slots} prompt{s_p} new{new} x{n_requests} "
+                  f"requests, submit every {submit_every_s * 1e3:.0f}ms, "
+                  f"local transport, role-parallel drive",
+        "fused": run_fused(),
+        "disagg_1_1": run_disagg(1, 1),
+        "disagg_2_1": run_disagg(2, 1),
+    }
+
+
 def bench_elastic_resume():
     """Elastic/preemption robustness perf (ISSUE 8, docs/ROBUSTNESS.md):
     what fault tolerance actually costs, on the gate.
@@ -1441,6 +1589,7 @@ def main():
         "decode": None,
         "serving": None,
         "serving_router": None,
+        "serving_disagg": None,
         "data_path": None,
         "long_context": None,
         "projected_scaling": projected,
@@ -1486,6 +1635,10 @@ def main():
                                "tokens_per_sec"),
             "router_shed_r2": g(result, "serving_router", "replicas_2",
                                 "shed_rate"),
+            "disagg_gap_p99_fused": g(result, "serving_disagg", "fused",
+                                      "tick_gap_p99_ms"),
+            "disagg_gap_p99_1_1": g(result, "serving_disagg",
+                                    "disagg_1_1", "tick_gap_p99_ms"),
             "flash_s8192_mfu": g(result, "long_context",
                                  "flash_fwd_bwd_S8192", "attn_mfu"),
             "flash_s16384_mfu": g(result, "long_context",
@@ -1625,6 +1778,23 @@ def main():
             emit()
     else:
         print("bench: over budget — serving_router section skipped",
+              file=sys.stderr)
+
+    # --- serving disagg: fused vs P:D role-split at fixed offered load -----
+    # (ISSUE 9) Every-backend contract; the decode tick-gap p50/p99/
+    # variance + transfer-ms keys gate direction-aware in
+    # bench_history.jsonl — the acceptance metric is the disagg points'
+    # tick_gap_p99_over_p50 sitting strictly below fused.
+    if not over_budget():
+        try:
+            result["serving_disagg"] = bench_serving_disagg()
+            emit("serving_disagg")
+        except Exception as e:
+            print(f"bench: serving_disagg section failed: {e!r}",
+                  file=sys.stderr)
+            emit()
+    else:
+        print("bench: over budget — serving_disagg section skipped",
               file=sys.stderr)
 
     # --- elastic resume: checkpoint/reshard/preemption cost (ISSUE 8) ------

@@ -375,6 +375,58 @@ def _build_prefix_copy() -> Dict[str, Any]:
             }}
 
 
+def _build_kv_transfer() -> Dict[str, Any]:
+    """The disaggregated fleet's same-process KV-slab transfer (ISSUE
+    9): ``KvTransferPlane.local_program`` over two REAL pools — a
+    prefill worker's staging pool and a decode worker's pool — at tiny
+    shapes.  The contract under analysis: slot indices are traced
+    operands, so ONE compiled program serves every (src, dst) slot
+    pair (a recompile per pair would rebuild it on every transfer),
+    and with both pools sharding the KV columns identically the PR 8
+    reshard lowers to IDENTITY — zero collectives, held to an empty
+    ledger by the comm reconciliation (the lane-mode path books its
+    bytes as a noted ``kv_transfer_lane@dcn`` row instead, reconciled
+    in tests/test_serving_disagg.py against ``transfer_cost``)."""
+    import jax.numpy as jnp
+
+    from chainermn_tpu.serving.cache_pool import CachePool
+    from chainermn_tpu.serving.transfer import KvTransferPlane
+
+    params, specs, mesh = _tiny_lm()
+    head_dim = 4
+    n_kv = 2  # _tiny_lm: 2 heads, no GQA
+    dtype = params["embed"].dtype
+    staging = CachePool(2, 8, 1, n_kv * head_dim, dtype, mesh, "model")
+    decode = CachePool(3, 8, 1, n_kv * head_dim, dtype, mesh, "model")
+    plane = KvTransferPlane()
+    jfn = plane.local_program(staging, decode)
+
+    def run(src_caches, dst_caches, src, dst):
+        return jfn(src_caches, dst_caches, src, dst)
+
+    args0 = (staging.caches, decode.caches, jnp.int32(0), jnp.int32(1))
+    variants = (jfn, [
+        args0,
+        (staging.caches, decode.caches, jnp.int32(1), jnp.int32(2)),
+        (staging.caches, decode.caches, jnp.int32(0), jnp.int32(0)),
+    ])
+    return {"trace": (run, args0),
+            "bound_axes": {"model"},
+            "variants": variants,
+            "data_axis": "model",
+            "arg_labels": ("src_caches", "dst_caches", "src", "dst"),
+            # both pools' caches thread in SHARDED P(None, None, model)
+            # like the prefix-copy entry; only the host-fed slot scalars
+            # replicate by design
+            "expected_replication": {
+                "src": "source staging-slot index: one host-fed int32 "
+                       "scalar per transfer, replicated to every TP "
+                       "rank by design",
+                "dst": "destination (reserved) slot index: same 4-byte "
+                       "host-fed scalar as src",
+            }}
+
+
 def _build_reshard() -> Dict[str, Any]:
     """The portable redistribution primitive (ISSUE 8,
     ``parallel/reshard.py``): BOTH wire-bearing (src, dst) spec pairs —
@@ -632,6 +684,14 @@ ENTRYPOINTS = [
                     "(DecodeEngine.copy_prefix): zero collectives, one "
                     "compiled program across (src, dst) slot variants "
                     "(ISSUE 7)"),
+    EntryPoint(
+        name="serving.kv_transfer",
+        build=_build_kv_transfer,
+        description="disaggregated KV-slab transfer "
+                    "(KvTransferPlane.local_program): one compiled "
+                    "program across (src, dst) slot variants, identity "
+                    "reshard at matching pool specs — zero collectives, "
+                    "bytes ledger-reconciled (ISSUE 9)"),
     EntryPoint(
         name="serving.tick_with_tracing",
         build=_build_tick_with_tracing,

@@ -334,6 +334,24 @@ class CommunicatorBase:
         import jax as _jax
         return {_jax.process_index(): obj}
 
+    def kv_lane_transport(self):
+        """Object-lane transport (``put(tag, bytes)`` / ``get(tag,
+        timeout_s)`` / ``delete(tag)``) for bulk payloads addressed by
+        TAG rather than gathered by gang — the serving KV-transfer
+        plane's wire (ISSUE 9: a prefill worker publishes a finished
+        slab, exactly one decode worker consumes it; a gang collective
+        is the wrong shape).  Callers wrap every operation in
+        :func:`lane_call`, so faults ride the hardened retry/
+        classification discipline and the flight ring NAMES the lane.
+        Single-controller backends loop back through one in-process
+        store; multi-controller backends override with the
+        jax.distributed KV store."""
+        store = getattr(self, "_kv_lane_store", None)
+        if store is None:
+            from ..serving.transfer import InProcessLaneStore
+            store = self._kv_lane_store = InProcessLaneStore()
+        return store
+
     def allreduce_obj(self, obj: Any, op: Callable = None) -> Any:
         raise NotImplementedError
 

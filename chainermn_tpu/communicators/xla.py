@@ -491,6 +491,34 @@ class XlaCommunicator(CommunicatorBase):
                     pass  # absent this round — degraded, never wedged
         return out
 
+    def kv_lane_transport(self):
+        """The serving KV-transfer plane's wire over the jax.distributed
+        KV store (ISSUE 9): tag-addressed put/get/delete with the same
+        idempotent-set discipline the checkpoint lanes use.  The raw
+        store ops raise freely — the transfer plane wraps each call in
+        ``lane_call``, which classifies, retries, and names the lane.
+        Single-process falls back to the in-process loopback store."""
+        if not self._multiprocess():
+            return super().kv_lane_transport()
+        comm = self
+
+        class _KvStoreLane:
+            def put(self, tag: str, payload: bytes) -> None:
+                comm._kv_set_overwrite(comm._kv_client(),
+                                       f"chainermn_tpu_kvxfer/{tag}",
+                                       bytes(payload))
+
+            def get(self, tag: str, timeout_s: float = 10.0) -> bytes:
+                return comm._kv_client().blocking_key_value_get_bytes(
+                    f"chainermn_tpu_kvxfer/{tag}",
+                    max(int(float(timeout_s) * 1000), 1))
+
+            def delete(self, tag: str) -> None:
+                comm._kv_client().key_value_delete(
+                    f"chainermn_tpu_kvxfer/{tag}")
+
+        return _KvStoreLane()
+
     def allreduce_obj(self, obj: Any, op: Callable = None) -> Any:
         op = op or (lambda a, b: a + b)
         gathered = self.allgather_obj(obj)

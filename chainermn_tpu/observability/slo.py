@@ -117,8 +117,12 @@ class GoodputLedger:
     #: ``checkpoint`` (ISSUE 8): final-save overhead on the preemption
     #: path and periodic-save flush time — booked, not vanished, so the
     #: goodput table shows what fault tolerance actually costs.
+    #: ``transfer`` (ISSUE 9): KV-slab transfer wall on the
+    #: disaggregated serving path (prefill worker → decode worker) —
+    #: its own bucket so the P:D tuning loop sees what the transfer
+    #: plane costs instead of it hiding inside ``host``.
     BUCKETS = ("compute", "comm", "host", "compile", "queue_wait", "stall",
-               "checkpoint")
+               "checkpoint", "transfer")
 
     def __init__(self, wall_clock: Callable[[], float] = time.monotonic):
         self._clock = wall_clock

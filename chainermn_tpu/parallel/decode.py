@@ -242,6 +242,68 @@ def _greedy_token(table, h_last, axis_name: str):
         jnp.where(winner, local_idx, jnp.int32(2 ** 30)), axis_name)
 
 
+def _next_token(table, h_last, axis_name, keys, temps, step_pos):
+    """Per-row greedy-OR-sampled next token from ``h_last (N, D)`` —
+    the serving tick's selection step (ISSUE 9 sampling plumbing).
+
+    ``keys (N, 2) uint32`` is each row's REQUEST rng key, ``temps (N,)``
+    its temperature (``<= 0`` → greedy), ``step_pos (N,) int32`` the
+    position being generated.  Rows with ``temps > 0`` draw the exact
+    Gumbel trick of :func:`lm_generate`'s sampled path — same key
+    folding ``fold_in(fold_in(rng, step_pos), axis_index)``, same
+    ``(1, V/P)`` uniform draw per row — so a request sampled through
+    the shared serving pool is TOKEN-EXACT vs ``lm_generate(rng=...)``
+    alone at the same key (the tests/test_serving_disagg.py oracle).
+    Rows with ``temps <= 0`` reproduce :func:`_greedy_token` bit-for-
+    bit (the selection happens BEFORE the shared pmax/pmin pair, which
+    is rowwise).  ONE (pmax, pmin) pair either way: the full ``(N, V)``
+    logits never materialize on one chip."""
+    from ..ops import collective as _col
+
+    vocab_per = table.shape[0]
+    start = jax.lax.axis_index(axis_name) * vocab_per
+    logits = jnp.einsum("bd,vd->bv", h_last, table,
+                        preferred_element_type=jnp.float32)
+    g_best = logits.max(-1)
+    g_idx = start + logits.argmax(-1)
+
+    def row_gumbel(key, sp):
+        # mirror lm_generate's logits_next exactly: step-pos salt, then
+        # axis salt, then a (1, V/P) uniform (the B=1 oracle's shape —
+        # threefry bits depend on the flat draw count, asserted by the
+        # token-exactness test)
+        k = jax.random.fold_in(jax.random.fold_in(key, sp),
+                               jax.lax.axis_index(axis_name))
+        return -jnp.log(-jnp.log(
+            jax.random.uniform(k, (1, vocab_per), minval=1e-20)))[0]
+
+    sample = temps > 0.0
+
+    def sampled_branch():
+        gumbel = jax.vmap(row_gumbel)(keys, step_pos)
+        safe_t = jnp.where(sample, temps, 1.0)
+        scored = logits / safe_t[:, None] + gumbel
+        s_best = scored.max(-1)
+        s_idx = start + scored.argmax(-1)
+        return (jnp.where(sample, s_best, g_best),
+                jnp.where(sample, s_idx, g_idx))
+
+    # an all-greedy batch (the serving default) skips the N×(V/P)
+    # threefry draw entirely — cond, not where, so the hot decode tick
+    # pays for sampling only when some row actually samples; no
+    # collectives inside either branch (the shared pmax/pmin pair
+    # below runs unconditionally, so every rank takes the same path
+    # through the accounted face)
+    local_best, local_idx = jax.lax.cond(
+        jnp.any(sample), sampled_branch, lambda: (g_best, g_idx))
+    # accounted face, like _greedy_token: the serving tick's argmax pair
+    # stays ledger-visible for the shard-flow reconciliation
+    gbest = _col.pmax(local_best, axis_name)
+    winner = (local_best == gbest)
+    return _col.pmin(
+        jnp.where(winner, local_idx, jnp.int32(2 ** 30)), axis_name)
+
+
 def lm_prefill(params, prompt, total: int, *, head_dim: int, axis_name: str):
     """Iteration-level PREFILL step: run the full ``prompt (B, S_p)``
     through the stack, returning ``(h, caches)`` — ``h (B, S_p, D)`` is

@@ -74,6 +74,22 @@ def main(argv=None):
                              "7): N engines, least-loaded prefix-affine "
                              "dispatch, SLO-aware shedding; 1 = the "
                              "single-engine path")
+    parser.add_argument("--disagg", default=None, metavar="P:D",
+                        help="disaggregated topology (ISSUE 9): P "
+                             "prefill workers + D decode workers with "
+                             "the KV-transfer plane between them "
+                             "(e.g. --disagg 1:2); mutually exclusive "
+                             "with --replicas > 1")
+    parser.add_argument("--transport", default="local",
+                        choices=["local", "lanes"],
+                        help="disagg KV-transfer transport: 'local' = "
+                             "the compiled reshard path, 'lanes' = the "
+                             "DCN object lanes (ledger-booked bytes)")
+    parser.add_argument("--temperature", type=float, default=0.0,
+                        help="per-request sampling temperature (0 = "
+                             "greedy); >0 samples under the lm_generate "
+                             "rng contract with per-request keys derived "
+                             "from --seed")
     parser.add_argument("--n-slots", type=int, default=4)
     parser.add_argument("--max-total", type=int, default=None,
                         help="per-slot capacity (default: fits prompt + "
@@ -192,7 +208,30 @@ def main(argv=None):
         max_total=args.max_total or max(total_len, 8),
         mesh=serve_mesh, queue_capacity=args.queue_capacity)
     router = None
-    if args.replicas > 1:
+    disagg = None
+    if args.disagg:
+        if args.replicas > 1:
+            raise SystemExit("--disagg and --replicas > 1 are mutually "
+                             "exclusive topologies")
+        try:
+            n_p, n_d = (int(x) for x in args.disagg.split(":"))
+        except ValueError:
+            raise SystemExit(f"--disagg wants P:D (e.g. 1:2), got "
+                             f"{args.disagg!r}")
+        if n_p < 1 or n_d < 1:
+            raise SystemExit(f"--disagg needs at least one worker per "
+                             f"role, got {args.disagg!r}")
+        from chainermn_tpu.serving import build_disagg_fleet
+        disagg = build_disagg_fleet(
+            trained, n_p, n_d, head_dim=head_dim,
+            max_total=eng_kwargs["max_total"],
+            n_slots=args.n_slots, mesh=serve_mesh,
+            queue_capacity=args.queue_capacity,
+            transport_mode=args.transport, slo=slo,
+            metrics_writer=writer,
+            bundle_dir=args.flight_dump_dir)
+        eng = None
+    elif args.replicas > 1:
         from chainermn_tpu.serving import build_fleet
         # the fleet shares ONE SLO tracker (all replicas burn one
         # budget) and the router owns the JSONL writer (router_rejection
@@ -203,7 +242,8 @@ def main(argv=None):
     else:
         eng = ServingEngine(trained, metrics_writer=writer, slo=slo,
                             **eng_kwargs)
-    service = router if router is not None else eng
+    service = disagg if disagg is not None else (
+        router if router is not None else eng)
     statusz = None
     if args.statusz_port is not None:
         statusz = obs.start_status_server(
@@ -221,16 +261,29 @@ def main(argv=None):
 
     handles, rejected = {}, {}
     first_wave = min(args.n_slots, args.requests)
+    # per-request sampling keys under the lm_generate contract: one key
+    # per request derived from --seed, so a re-run with the same seed
+    # samples the same sequences and two requests never share noise
+    sample_kw = {}
+    if args.temperature > 0:
+        base_key = jax.random.PRNGKey(args.seed + 1)
+        sample_kw = {i: {"temperature": args.temperature,
+                         "rng": jax.random.fold_in(base_key, i)}
+                     for i in range(args.requests)}
 
     def submit(i):
         try:
             handles[i] = service.submit(prompts[i], args.max_new_tokens,
-                                        on_token=stream)
+                                        on_token=stream,
+                                        **sample_kw.get(i, {}))
         except AdmissionError as e:
             rejected[i] = e.to_dict()
             print(f"request {i} rejected: {e}", file=sys.stderr)
 
     def service_busy():
+        if disagg is not None:
+            return (any(not w.idle for w in disagg.prefill_workers)
+                    or any(not w.idle for w in disagg.decode_workers))
         if router is not None:
             return any(not rep.idle for rep in router.replicas)
         return (eng.scheduler.queue_depth > 0
@@ -246,10 +299,7 @@ def main(argv=None):
         return budget is None or steps < budget
 
     while can_step() and (nxt < args.requests or service_busy()):
-        if router is not None:
-            router.step()
-        else:
-            eng.step()
+        service.step()
         steps += 1
         if nxt < args.requests and steps % max(args.stagger_every, 1) == 0:
             submit(nxt)
@@ -282,7 +332,16 @@ def main(argv=None):
               f"(true continuation {want[i].tolist()})", file=sys.stderr)
 
     metrics = service.metrics()
-    if router is not None:
+    if disagg is not None:
+        # per-worker wall-clock partitions: prefill ledgers carry the
+        # transfer bucket, decode ledgers the tick compute/queue-wait
+        # split (summing across workers double-counts wall)
+        goodput = dict(
+            {w.name: w.goodput.report()
+             for w in disagg.prefill_workers},
+            **{w.name: w.engine.goodput.report()
+               for w in disagg.decode_workers})
+    elif router is not None:
         # per-replica wall-clock partitions (each replica's ledger is
         # its own 5%-reconciled partition; summing them double-counts)
         goodput = {rep.name: rep.engine.goodput.report()
@@ -290,10 +349,7 @@ def main(argv=None):
     else:
         goodput = eng.goodput.report()
     if writer is not None:
-        if router is not None:
-            router.finalize_metrics()
-        else:
-            eng.finalize_metrics()
+        service.finalize_metrics()
         writer.close()
     if args.prom_out:
         service.write_prometheus(args.prom_out)
@@ -306,6 +362,7 @@ def main(argv=None):
         "schema": "chainermn_tpu.serve.v1",
         "engine_steps": steps,
         "replicas": args.replicas,
+        "disagg": args.disagg,
         "requests": per_request,
         "mean_continuation_accuracy": (
             round(float(np.mean(correct)), 3) if correct else None),

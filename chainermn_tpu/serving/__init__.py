@@ -42,9 +42,22 @@ admission"):
   deadline-aware prefix-affine dispatch, SLO-burn-driven shedding with
   machine-readable rejections, fleet-wide metrics//statusz roll-up.
 
+Disaggregated layer (ISSUE 9; docs/SERVING.md "Disaggregated
+prefill/decode"):
+
+* :mod:`~chainermn_tpu.serving.transfer` — the KV-transfer plane:
+  finished prefill slabs move between pools over the PR 8 reshard
+  primitive same-process or the hardened DCN object lanes across
+  processes, every transfer ledger-booked and statically priced.
+* :mod:`~chainermn_tpu.serving.disagg` — role-split workers
+  (:class:`PrefillWorker` runs only the prefill programs,
+  :class:`DecodeWorker` only the compiled tick) behind a role-aware
+  :class:`DisaggRouter` (prompts → least-loaded prefill worker, slabs
+  → decode worker by free slots + deadline feasibility).
+
 ``python -m chainermn_tpu.serve`` is the CLI demo over the toy-corpus
-LM from ``examples/generate`` (``--replicas N`` stands up the fleet).
-See docs/SERVING.md.
+LM from ``examples/generate`` (``--replicas N`` stands up the fleet,
+``--disagg P:D`` the disaggregated topology).  See docs/SERVING.md.
 """
 
 from .scheduler import (  # noqa: F401
@@ -58,7 +71,9 @@ from .prefix_cache import PrefixCache, PrefixEntry  # noqa: F401
 __all__ = ["AdmissionError", "Request", "Scheduler", "SlotAllocator",
            "PrefixCache", "PrefixEntry",
            "ServingEngine", "RequestHandle", "CachePool", "DecodeEngine",
-           "Replica", "ServingRouter", "build_fleet"]
+           "Replica", "ServingRouter", "build_fleet",
+           "KvTransferPlane", "DisaggRouter", "PrefillWorker",
+           "DecodeWorker", "build_disagg_fleet"]
 
 
 def __getattr__(name):
@@ -80,4 +95,11 @@ def __getattr__(name):
     if name in ("ServingRouter", "build_fleet"):
         from . import router
         return getattr(router, name)
+    if name == "KvTransferPlane":
+        from .transfer import KvTransferPlane
+        return KvTransferPlane
+    if name in ("DisaggRouter", "PrefillWorker", "DecodeWorker",
+                "build_disagg_fleet"):
+        from . import disagg
+        return getattr(disagg, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

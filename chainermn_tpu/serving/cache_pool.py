@@ -24,6 +24,21 @@ cross-talk fuzz in tests/test_serving.py.
 :class:`SlotAllocator` is the jax-free bookkeeping half (fuzzable
 standalone); :class:`CachePool` adds the device buffers.
 
+Transfer-destination reservations (ISSUE 9): the disaggregated fleet
+lands finished prefill KV slabs into a DECODE worker's slot, and the
+destination must be held from the moment the transfer is chosen until
+the slab arrives — otherwise the worker's own admission path (which
+admits up to ``min(free_slots, max_prefills_per_tick)``) can take the
+slot out from under an in-flight transfer, and a burst of arriving
+slabs deadlocks against admission.  Reservations are therefore
+FIRST-CLASS allocator state: ``reserve()`` moves a slot free →
+reserved (it no longer counts in ``free_count``, so admission can never
+see it), ``commit_reservation()`` promotes it to busy when the slab
+lands, and ``cancel_reservation()`` returns it to the free list when
+the transfer fails (lane fault, dead source worker).  The invariants
+are hard errors for the same reason double-release is: a leaked
+reservation silently shrinks the pool forever.
+
 Prefix-cache extension (ISSUE 7): a slot now has THREE states, not two
 — ``free`` (on the free list), ``busy`` (a live request's K/V), and
 ``cached`` (a finished request's prompt K/V donated to the radix-trie
@@ -38,6 +53,7 @@ ref pins a slot forever, silently shrinking the pool.
 
 from __future__ import annotations
 
+import threading
 from typing import Dict, List, Optional
 
 
@@ -58,65 +74,115 @@ class SlotAllocator:
         self._free: List[int] = list(range(self.n_slots))
         self._busy: set = set()
         self._cached: Dict[int, int] = {}   # slot -> refcount
+        self._reserved: set = set()         # in-flight transfer dests
+        # the disagg fleet's role-parallel drive reserves from the
+        # prefill thread while commit/cancel/release run on the decode
+        # thread — every state transition is a compound read-then-write,
+        # so the lock is load-bearing, not defensive
+        self._lock = threading.Lock()
 
     def acquire(self) -> Optional[int]:
         """Lowest free slot index, or None when the pool is saturated."""
-        if not self._free:
-            return None
-        slot = self._free.pop(0)
-        self._busy.add(slot)
-        return slot
+        with self._lock:
+            if not self._free:
+                return None
+            slot = self._free.pop(0)
+            self._busy.add(slot)
+            return slot
 
     def release(self, slot: int) -> None:
-        if slot not in self._busy:
-            raise ValueError(f"slot {slot} is not busy (double release or "
-                             f"foreign slot); busy={sorted(self._busy)}")
-        self._busy.remove(slot)
-        # keep the free list sorted so acquisition order is deterministic
-        self._free.append(slot)
-        self._free.sort()
+        with self._lock:
+            if slot not in self._busy:
+                raise ValueError(
+                    f"slot {slot} is not busy (double release or "
+                    f"foreign slot); busy={sorted(self._busy)}")
+            self._busy.remove(slot)
+            # keep the free list sorted so acquisition order is
+            # deterministic
+            self._free.append(slot)
+            self._free.sort()
+
+    # ---- transfer-destination reservations: free -> reserved -> busy ----
+    def reserve(self) -> Optional[int]:
+        """Hold the lowest free slot for an in-flight KV transfer, or
+        None when the pool is saturated.  A reserved slot is invisible
+        to ``acquire``/``free_count`` — admission can never race the
+        arriving slab for it."""
+        with self._lock:
+            if not self._free:
+                return None
+            slot = self._free.pop(0)
+            self._reserved.add(slot)
+            return slot
+
+    def commit_reservation(self, slot: int) -> None:
+        """The slab landed: promote the reservation to a busy slot."""
+        with self._lock:
+            if slot not in self._reserved:
+                raise ValueError(
+                    f"slot {slot} is not reserved (commit "
+                    f"without reserve, or double commit); "
+                    f"reserved={sorted(self._reserved)}")
+            self._reserved.remove(slot)
+            self._busy.add(slot)
+
+    def cancel_reservation(self, slot: int) -> None:
+        """The transfer failed: return the held slot to the free list."""
+        with self._lock:
+            if slot not in self._reserved:
+                raise ValueError(
+                    f"slot {slot} is not reserved (cancel "
+                    f"without reserve, or double cancel); "
+                    f"reserved={sorted(self._reserved)}")
+            self._reserved.remove(slot)
+            self._free.append(slot)
+            self._free.sort()
 
     # ---- prefix-cache faces: busy -> cached(rc) -> free ----
     def cache(self, slot: int) -> None:
         """Donate a busy slot to the prefix cache (read-only, rc=0)."""
-        if slot not in self._busy:
-            raise ValueError(f"slot {slot} is not busy (only a live "
-                             f"request's slot can be donated); "
-                             f"busy={sorted(self._busy)}")
-        self._busy.remove(slot)
-        self._cached[slot] = 0
+        with self._lock:
+            if slot not in self._busy:
+                raise ValueError(f"slot {slot} is not busy (only a live "
+                                 f"request's slot can be donated); "
+                                 f"busy={sorted(self._busy)}")
+            self._busy.remove(slot)
+            self._cached[slot] = 0
 
     def retain(self, slot: int) -> int:
         """Pin a cached slot for one more in-flight reader."""
-        if slot not in self._cached:
-            raise ValueError(f"slot {slot} is not cached; "
-                             f"cached={sorted(self._cached)}")
-        self._cached[slot] += 1
-        return self._cached[slot]
+        with self._lock:
+            if slot not in self._cached:
+                raise ValueError(f"slot {slot} is not cached; "
+                                 f"cached={sorted(self._cached)}")
+            self._cached[slot] += 1
+            return self._cached[slot]
 
     def unretain(self, slot: int) -> int:
-        if slot not in self._cached:
-            raise ValueError(f"slot {slot} is not cached; "
-                             f"cached={sorted(self._cached)}")
-        if self._cached[slot] <= 0:
-            raise ValueError(f"slot {slot} refcount underflow (double "
-                             f"unretain)")
-        self._cached[slot] -= 1
-        return self._cached[slot]
+        with self._lock:
+            if slot not in self._cached:
+                raise ValueError(f"slot {slot} is not cached; "
+                                 f"cached={sorted(self._cached)}")
+            if self._cached[slot] <= 0:
+                raise ValueError(f"slot {slot} refcount underflow "
+                                 f"(double unretain)")
+            self._cached[slot] -= 1
+            return self._cached[slot]
 
     def uncache(self, slot: int) -> None:
         """Evict a cached slot back to the free list (rc must be 0: an
         entry someone is still built on must never be recycled)."""
-        rc = self._cached.get(slot)
-        if rc is None:
-            raise ValueError(f"slot {slot} is not cached; "
-                             f"cached={sorted(self._cached)}")
-        if rc != 0:
-            raise ValueError(f"slot {slot} still has {rc} reader(s); "
-                             f"refusing to evict a pinned prefix")
-        del self._cached[slot]
-        self._free.append(slot)
-        self._free.sort()
+        with self._lock:
+            rc = self._cached.get(slot)
+            if rc is None:
+                raise ValueError(f"slot {slot} is not cached; "
+                                 f"cached={sorted(self._cached)}")
+            if rc != 0:
+                raise ValueError(f"slot {slot} still has {rc} reader(s); "
+                                 f"refusing to evict a pinned prefix")
+            del self._cached[slot]
+            self._free.append(slot)
+            self._free.sort()
 
     def refcount(self, slot: int) -> Optional[int]:
         return self._cached.get(slot)
@@ -133,16 +199,22 @@ class SlotAllocator:
     def cached_count(self) -> int:
         return len(self._cached)
 
+    @property
+    def reserved_count(self) -> int:
+        return len(self._reserved)
+
     def check_invariants(self) -> None:
-        """No leak, no alias: free ∪ busy ∪ cached is exactly
+        """No leak, no alias: free ∪ busy ∪ cached ∪ reserved is exactly
         {0..n_slots-1}, pairwise disjoint, and every refcount >= 0."""
         free, busy = set(self._free), set(self._busy)
-        cached = set(self._cached)
+        cached, reserved = set(self._cached), set(self._reserved)
         assert not (free & busy), (free, busy)
         assert not (free & cached), (free, cached)
         assert not (busy & cached), (busy, cached)
-        assert free | busy | cached == set(range(self.n_slots)), \
-            (free, busy, cached)
+        assert not (reserved & (free | busy | cached)), \
+            (reserved, free, busy, cached)
+        assert free | busy | cached | reserved \
+            == set(range(self.n_slots)), (free, busy, cached, reserved)
         assert all(rc >= 0 for rc in self._cached.values()), self._cached
 
 
@@ -198,6 +270,19 @@ class CachePool:
         self.pos[slot] = 0
         self.allocator.release(slot)
 
+    # transfer-destination reservations (ISSUE 9).  The committing
+    # caller (the KV-transfer plane) sets ``pos[slot]`` itself — the
+    # landed slab's length is transfer metadata the pool cannot know.
+    def reserve(self) -> Optional[int]:
+        return self.allocator.reserve()
+
+    def commit_reservation(self, slot: int) -> None:
+        self.allocator.commit_reservation(slot)
+
+    def cancel_reservation(self, slot: int) -> None:
+        self.pos[slot] = 0
+        self.allocator.cancel_reservation(slot)
+
     # prefix-cache faces.  A cached slot's ``pos`` is deliberately NOT
     # reset: the tick still advances every slot's position, so the
     # cached slot's garbage writes keep landing at its drifting pos —
@@ -228,3 +313,7 @@ class CachePool:
     @property
     def cached_count(self) -> int:
         return self.allocator.cached_count
+
+    @property
+    def reserved_count(self) -> int:
+        return self.allocator.reserved_count

@@ -101,39 +101,46 @@ class DecodeEngine:
     def _build_tick(self):
         import jax
 
-        from ..parallel.decode import _greedy_token, lm_decode_tick
+        from ..parallel.decode import _next_token, lm_decode_tick
 
         axis, head_dim = self.axis_name, self.head_dim
         P = self._P
 
-        def tick_inner(params, caches, tokens, pos):
+        def tick_inner(params, caches, tokens, pos, keys, temps):
             h_last, new_caches = lm_decode_tick(
                 params, tokens, caches, pos, head_dim=head_dim,
                 axis_name=axis)
-            nxt = _greedy_token(params["embed"], h_last, axis)
+            # the consumed token sits at row ``pos``; the selected next
+            # token is position ``pos + 1`` — lm_generate's step_pos
+            # salt, so sampling stays token-exact per request
+            nxt = _next_token(params["embed"], h_last, axis, keys, temps,
+                              pos + 1)
             return nxt, new_caches
 
         return jax.jit(self._shard_map(
             tick_inner, mesh=self.mesh,
-            in_specs=(self._specs, self._cache_specs, P(), P()),
+            in_specs=(self._specs, self._cache_specs, P(), P(), P(), P()),
             out_specs=(P(), self._cache_specs)))
 
     def _build_prefill(self, s_pad: int):
         import jax
 
-        from ..parallel.decode import _greedy_token, lm_prefill
+        from ..parallel.decode import _next_token, lm_prefill
 
         axis, head_dim = self.axis_name, self.head_dim
         P = self._P
 
-        def prefill_inner(params, caches, prompt, s_real, slot):
+        def prefill_inner(params, caches, prompt, s_real, slot, key, temp):
             # slab caches sized to the padded prompt only; pads are above
             # every real row and never read back (causal + pos mask)
             h, slabs = lm_prefill(params, prompt, s_pad, head_dim=head_dim,
                                   axis_name=axis)
             h_last = jax.lax.dynamic_index_in_dim(h, s_real - 1, axis=1,
                                                   keepdims=False)
-            tok = _greedy_token(params["embed"], h_last, axis)
+            # first generated token = position s_real (lm_generate's
+            # first = logits_next(h[:, -1], s_p) salt)
+            tok = _next_token(params["embed"], h_last, axis, key[None],
+                              temp[None], s_real[None])
             new_caches = []
             for (kc, vc), (ks, vs) in zip(caches, slabs):
                 start = (slot, 0, 0)
@@ -146,7 +153,8 @@ class DecodeEngine:
 
         return jax.jit(self._shard_map(
             prefill_inner, mesh=self.mesh,
-            in_specs=(self._specs, self._cache_specs, P(), P(), P()),
+            in_specs=(self._specs, self._cache_specs, P(), P(), P(), P(),
+                      P()),
             out_specs=(P(), self._cache_specs)))
 
     def _build_prefix_copy(self):
@@ -184,11 +192,15 @@ class DecodeEngine:
         b = self.prefill_bucket
         return ((int(s_real) + b - 1) // b) * b
 
-    def prefill_into_slot(self, prompt_tokens, slot: int) -> int:
+    def prefill_into_slot(self, prompt_tokens, slot: int, *,
+                          rng=None, temperature: float = 0.0) -> int:
         """Prefill ``prompt_tokens (S,)`` into ``slot``: writes the K/V
         slab into the pool's caches, sets ``pool.pos[slot]``, and returns
-        the FIRST generated token (greedy).  One compile per padded
-        length, cached."""
+        the FIRST generated token — greedy at ``temperature <= 0``,
+        Gumbel-sampled with the request's ``rng`` key otherwise (the
+        ``lm_generate`` sampling contract, ISSUE 9).  One compile per
+        padded length, cached; rng/temperature are traced operands, so
+        greedy and sampled requests share the program."""
         import jax.numpy as jnp
 
         prompt = np.asarray(prompt_tokens, np.int32).reshape(1, -1)
@@ -213,9 +225,12 @@ class DecodeEngine:
                          padded_len=s_pad,
                          family_size=len(self._prefill_progs))
         self.prefill_calls += 1
+        key = (np.zeros(2, np.uint32) if rng is None
+               else np.asarray(rng, np.uint32).reshape(2))
         tok, self.pool.caches = prog(
             self._params, self.pool.caches, jnp.asarray(prompt),
-            jnp.int32(s_real), jnp.int32(slot))
+            jnp.int32(s_real), jnp.int32(slot), jnp.asarray(key),
+            jnp.float32(temperature))
         self.pool.pos[slot] = s_real
         return int(np.asarray(tok)[0])
 
@@ -245,11 +260,15 @@ class DecodeEngine:
             self.pool.caches, jnp.int32(src_slot), jnp.int32(dst_slot))
         self.pool.pos[dst_slot] = int(prefix_len)
 
-    def tick(self, last_tokens: np.ndarray) -> np.ndarray:
+    def tick(self, last_tokens: np.ndarray, keys=None,
+             temps=None) -> np.ndarray:
         """One decode tick for ALL slots: consume ``last_tokens
         (n_slots,)`` at the pool's per-slot positions, append K/V in
         place, advance every position, and return the next token per
-        slot (the caller keeps only the active rows)."""
+        slot (the caller keeps only the active rows).  ``keys (n_slots,
+        2) uint32`` / ``temps (n_slots,)`` carry each slot's request rng
+        and temperature (ISSUE 9 sampling plumbing); None = all-greedy
+        (dummy keys, never consumed)."""
         import jax.numpy as jnp
 
         self.tick_calls += 1
@@ -259,8 +278,14 @@ class DecodeEngine:
         # ``pos += 1`` below would race the still-executing tick (seen as
         # a repeated first token under cold-compile latency).
         pos = jnp.asarray(np.array(self.pool.pos, np.int32, copy=True))
+        if keys is None:
+            keys = np.zeros((self.pool.n_slots, 2), np.uint32)
+        if temps is None:
+            temps = np.zeros(self.pool.n_slots, np.float32)
         nxt, self.pool.caches = self._tick_prog(
-            self._params, self.pool.caches, tokens, pos)
+            self._params, self.pool.caches, tokens, pos,
+            jnp.asarray(np.array(keys, np.uint32, copy=True)),
+            jnp.asarray(np.array(temps, np.float32, copy=True)))
         self.pool.pos = self.pool.pos + 1  # out-of-place: never mutate a
         #                                    buffer jax might still read
         return np.asarray(nxt)

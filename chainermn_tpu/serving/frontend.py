@@ -109,6 +109,14 @@ class RequestHandle:
             return (ts["first_token"] - ts["submitted"]) * 1e3
         return None
 
+    @property
+    def shed_payload(self) -> Optional[Dict[str, Any]]:
+        """The machine-readable ``AdmissionError.to_dict()`` payload
+        when a disaggregated fleet shed this ALREADY-ACCEPTED request
+        (reason ``worker_lost`` — its prefill worker died mid-transfer
+        with no retry budget; ISSUE 9), else None."""
+        return getattr(self._req, "shed_payload", None)
+
     def wait(self, timeout: Optional[float] = None) -> bool:
         """Block until the request finishes; True iff it did."""
         return self._req.done_event.wait(timeout)
@@ -137,8 +145,11 @@ def _request_row(req: Request) -> Dict[str, Any]:
 class ServingEngine:
     """Continuous-batching inference engine over a slot-managed KV pool.
 
-    ``params``: GLOBAL ``init_tp_transformer_lm`` arrays (greedy decode
-    only — sampling needs per-request rng plumbing; see docs/SERVING.md).
+    ``params``: GLOBAL ``init_tp_transformer_lm`` arrays.  Decoding is
+    greedy by default; ``submit(temperature=..., rng=...)`` samples
+    per-request through the shared tick under the ``lm_generate`` rng
+    contract (ISSUE 9; temperature > 0 REQUIRES an explicit key — see
+    docs/SERVING.md).
     ``max_total`` bounds each slot's sequence (prompt + generated); a
     request that cannot fit is REJECTED at submit (``AdmissionError``,
     reason ``too_long``), as is any submit while the bounded queue is
@@ -193,6 +204,18 @@ class ServingEngine:
         self.stats_capacity = int(stats_capacity)
         self._ttft_ms = ReservoirSample(self.stats_capacity)
         self._tok_lat_ms = ReservoirSample(self.stats_capacity)
+        # decode tick-GAP: wall between consecutive tick starts while
+        # work is active — the inter-token latency a decoding request
+        # actually experiences.  In a fused engine a prefill between
+        # ticks inflates it; on a disagg decode worker it stays tight —
+        # the ISSUE 9 acceptance metric (tick_gap p99/p50 collapse).
+        self._tick_gap_ms = ReservoirSample(self.stats_capacity)
+        self._last_tick_start: Optional[float] = None
+        # per-slot sampling operands (ISSUE 9): each slot's request rng
+        # key + temperature ride every tick; greedy slots carry zeros
+        # (their key is never consumed)
+        self._slot_keys = np.zeros((self.pool.n_slots, 2), np.uint32)
+        self._slot_temps = np.zeros(self.pool.n_slots, np.float32)
         self._tokens_emitted = 0
         self._ticks = 0
         self._occupancy_sum = 0.0
@@ -220,20 +243,36 @@ class ServingEngine:
                eos_id: Optional[int] = None,
                deadline_s: Optional[float] = None,
                on_token: Optional[Callable[[int, int], None]] = None,
-               trace_id: Optional[str] = None) -> RequestHandle:
+               trace_id: Optional[str] = None,
+               temperature: float = 0.0,
+               rng=None) -> RequestHandle:
         """Enqueue a generation request; raises :class:`AdmissionError`
         (with ``.reason``) when the queue is full or it can never fit.
         ``on_token(token, request_id)`` streams each token from the
         driver thread as it is emitted; ``deadline_s`` is relative to
         now.  ``trace_id`` lets an upstream hop (the serving router)
         mint the distributed trace identity so its spans and the
-        engine's merge into one Perfetto lane."""
+        engine's merge into one Perfetto lane.  ``temperature > 0``
+        samples this request's tokens through the shared tick and
+        REQUIRES an explicit ``rng`` key (the ``lm_generate`` contract:
+        a silent default key would draw identical sequences every
+        call); greedy requests omit both."""
         now = time.monotonic()
         prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
+        temperature = float(temperature)
+        if temperature > 0.0 and rng is None:
+            raise ValueError(
+                "temperature > 0 samples tokens and needs an explicit "
+                "rng: pass jax.random.PRNGKey(...) (the lm_generate "
+                "contract — a silent default key would make every "
+                "sampled request draw IDENTICAL token sequences)")
+        key = (None if rng is None
+               else np.asarray(rng, np.uint32).reshape(2))
         req = Request(prompt, max_new_tokens, eos_id=eos_id,
                       deadline_t=(now + deadline_s
                                   if deadline_s is not None else None),
-                      on_token=on_token, trace_id=trace_id)
+                      on_token=on_token, trace_id=trace_id,
+                      temperature=temperature, rng=key)
         # tracer-clock stamp + flow BEGIN before the request becomes
         # visible to the scheduler: with start()'s driver thread, a
         # request can be admitted (even finished) the instant submit()
@@ -372,6 +411,7 @@ class ServingEngine:
             # iteration (``req.forced``)
             if entry is not None:
                 req.forced.extend(req.prompt[mlen:])
+                self._set_slot_sampling(slot, req)
                 self.goodput.add("host", t_admit - t_host)
                 t_cp = time.monotonic()
                 try:
@@ -418,7 +458,9 @@ class ServingEngine:
                               request=req.id, trace_id=req.trace_id,
                               slot=slot):
                     first = self.engine.prefill_into_slot(
-                        req.prompt, slot)
+                        req.prompt, slot, rng=req.rng,
+                        temperature=req.temperature)
+                self._set_slot_sampling(slot, req)
                 t_host = time.monotonic()
                 # the engine's own counter says whether THIS call built
                 # a new program — no probing of its cache internals
@@ -461,13 +503,21 @@ class ServingEngine:
                                 else req.tokens[-1])
             t_tick = time.monotonic()
             self.goodput.add("host", t_tick - t_host)
+            # inter-tick gap: what a decoding request waits between its
+            # tokens — includes any prefill that ran above (the fused
+            # engine's tail; see the disagg bench section, ISSUE 9)
+            if self._last_tick_start is not None:
+                self._tick_gap_ms.add((t_tick - self._last_tick_start)
+                                      * 1e3)
+            self._last_tick_start = t_tick
             tick_bucket = ("compile" if self.engine.tick_calls == 0
                            else "compute")
             t_tick_us = obs.now_us()
             with obs.span("serving/tick", cat="serving",
                           active=len(active)):
                 with self.goodput.measure(tick_bucket):
-                    nxt = self.engine.tick(tokens)
+                    nxt = self.engine.tick(tokens, self._slot_keys,
+                                           self._slot_temps)
             t_host = time.monotonic()
             dt_ms = (t_host - t_tick) * 1e3
             dt_us = obs.now_us() - t_tick_us
@@ -489,6 +539,10 @@ class ServingEngine:
                     self._emit(req, int(nxt[slot]), now)
                 self._tok_lat_ms.add(dt_ms / max(len(active), 1))
                 self._maybe_evict(req, now)
+        else:
+            # an idle step breaks the tick cadence: the next gap would
+            # measure stall, not inter-token latency — restart the clock
+            self._last_tick_start = None
 
         with self._lock:
             self._ticks += 1
@@ -548,6 +602,39 @@ class ServingEngine:
         if req.on_token is not None:
             req.on_token(int(token), req.id)
 
+    def _set_slot_sampling(self, slot: int, req: Request) -> None:
+        """Install the occupant's rng key + temperature as the slot's
+        tick operands (zeros for greedy — the key is never consumed)."""
+        self._slot_keys[slot] = (req.rng if req.rng is not None
+                                 else np.zeros(2, np.uint32))
+        self._slot_temps[slot] = np.float32(req.temperature)
+
+    # ---- disaggregation inject face (ISSUE 9) ----
+    def install_request(self, req: Request, slot: int,
+                        tokens) -> None:
+        """Adopt an already-prefilled request whose KV slab the
+        transfer plane just landed in ``slot`` (reservation committed
+        and ``pool.pos[slot]`` set by the caller): install sampling
+        operands, emit the tokens the prefill side already produced
+        (the first one stamps TTFT and streams), and start ticking it
+        next step.  The decode half of the disaggregated fleet — this
+        engine never ran a prefill for ``req``."""
+        req.slot = slot
+        req.status = "running"
+        now = time.monotonic()
+        req.timestamps.setdefault("prefill_start", now)
+        self._set_slot_sampling(slot, req)
+        obs.instant("serving/request/installed", cat="serving",
+                    request=req.id, slot=slot, trace_id=req.trace_id)
+        _flight.note("serving", event="installed", request=req.id,
+                     trace_id=req.trace_id, slot=slot,
+                     pos=int(self.pool.pos[slot]))
+        for tok in tokens:
+            self._emit(req, int(tok), now)
+        with self._lock:
+            self._running[slot] = req
+        self._maybe_evict(req, now)
+
     def _finish_tracing(self, req: Request, reason: str) -> None:
         """Close the request's async flow + tee the terminal event."""
         obs.async_event("e", "request", req.trace_id,
@@ -589,6 +676,7 @@ class ServingEngine:
         if req.prefix_entry is not None and self.prefix_cache is not None:
             self.prefix_cache.release(req.prefix_entry)
             req.prefix_entry = None
+        self._slot_temps[slot] = 0.0
         self.pool.release(slot)
 
     def _retire_slot(self, req: Request, slot: int) -> None:
@@ -601,6 +689,9 @@ class ServingEngine:
         if req.prefix_entry is not None and cache is not None:
             cache.release(req.prefix_entry)
             req.prefix_entry = None
+        # a freed/cached slot keeps ticking (one fixed program): force
+        # its discarded garbage row back to the cheap greedy path
+        self._slot_temps[slot] = 0.0
         if cache is not None:
             length = int(self.pool.pos[slot])
             seq = list(req.prompt) + list(req.tokens[:-1])
@@ -674,6 +765,8 @@ class ServingEngine:
             self._t0 = time.monotonic()
             self._ttft_ms = ReservoirSample(self.stats_capacity)
             self._tok_lat_ms = ReservoirSample(self.stats_capacity)
+            self._tick_gap_ms = ReservoirSample(self.stats_capacity)
+            self._last_tick_start = None
             self._tokens_emitted = 0
             self._ticks = 0
             self._occupancy_sum = 0.0
@@ -707,12 +800,18 @@ class ServingEngine:
                     else 0.0),
             }
             for name, res in (("ttft", self._ttft_ms),
-                              ("token_latency", self._tok_lat_ms)):
+                              ("token_latency", self._tok_lat_ms),
+                              ("tick_gap", self._tick_gap_ms)):
                 p50 = res.percentile(50)
                 p99 = res.percentile(99)
                 if p50 is not None:
                     out[f"serving/{name}_p50_ms"] = p50
                     out[f"serving/{name}_p99_ms"] = p99
+            gaps = self._tick_gap_ms.values()
+            if len(gaps) >= 2:
+                mean = sum(gaps) / len(gaps)
+                out["serving/tick_gap_variance_ms2"] = (
+                    sum((g - mean) ** 2 for g in gaps) / len(gaps))
         if self.prefix_cache is not None:
             for k, v in self.prefix_cache.stats().items():
                 out[f"serving/prefix/{k}"] = v
@@ -745,6 +844,7 @@ class ServingEngine:
             "max_total": self.pool.max_total,
             "busy_slots": self.pool.busy_count,
             "free_slots": self.pool.free_count,
+            "reserved_slots": self.pool.reserved_count,
             "queue_depth": self.scheduler.queue_depth,
             "queue_capacity": self.scheduler.queue_capacity,
             "ticks": self._ticks,

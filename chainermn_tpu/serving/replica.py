@@ -83,10 +83,12 @@ class Replica:
                eos_id: Optional[int] = None,
                deadline_s: Optional[float] = None,
                on_token=None,
-               trace_id: Optional[str] = None) -> RequestHandle:
+               trace_id: Optional[str] = None,
+               temperature: float = 0.0, rng=None) -> RequestHandle:
         return self.engine.submit(
             prompt, max_new_tokens, eos_id=eos_id, deadline_s=deadline_s,
-            on_token=on_token, trace_id=trace_id)
+            on_token=on_token, trace_id=trace_id, temperature=temperature,
+            rng=rng)
 
     def step(self):
         return self.engine.step()

@@ -63,11 +63,60 @@ from .frontend import RequestHandle
 from .replica import Replica
 from .scheduler import AdmissionError
 
-#: Rejection reasons the router can emit (PR 3's two + ISSUE 7's one).
-REJECT_REASONS = ("queue_full", "too_long", "shed_slo")
+#: Rejection reasons a router can emit: PR 3's two, ISSUE 7's
+#: ``shed_slo``, and ISSUE 9's ``worker_lost`` (a disaggregated
+#: transfer's source worker died and no survivor could re-run the
+#: prefill — the request is shed with the same machine-readable shape).
+REJECT_REASONS = ("queue_full", "too_long", "shed_slo", "worker_lost")
 
 
-class ServingRouter:
+class RouterBase:
+    """Shared router machinery (ISSUE 9 refactor): trace-id minting and
+    uniformly-shaped machine-readable rejections — one implementation
+    behind both the replica fleet (:class:`ServingRouter`) and the
+    disaggregated fleet (``serving/disagg.py::DisaggRouter``), so every
+    rejection anywhere in the serving stack carries the same
+    ``AdmissionError.to_dict()`` wire shape, per-reason counters, and
+    JSONL/flight/tracer emissions."""
+
+    #: flight/metrics namespace ("router" / "disagg") — subclasses set.
+    ROLE = "router"
+
+    def __init__(self, metrics_writer=None):
+        self.metrics_writer = metrics_writer
+        self._lock = threading.Lock()
+        self._ids = itertools.count()
+        self._rejected: Dict[str, int] = {r: 0 for r in REJECT_REASONS}
+
+    def _mint_trace_id(self) -> str:
+        return f"req-{os.getpid():x}-rt{next(self._ids):08x}"
+
+    def rejection_counters(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._rejected)
+
+    def _reject(self, reason: str, trace_id: str, detail: str, *,
+                retry_after_ms: float, queue_depth: int):
+        with self._lock:
+            self._rejected[reason] = self._rejected.get(reason, 0) + 1
+        err = AdmissionError(reason, detail,
+                             retry_after_ms=retry_after_ms,
+                             queue_depth=queue_depth)
+        obs.instant(f"{self.ROLE}/rejected", cat="serving", reason=reason,
+                    trace_id=trace_id, queue_depth=queue_depth)
+        _flight.note(self.ROLE, event="rejected", reason=reason,
+                     trace_id=trace_id, detail=detail)
+        if self.metrics_writer is not None:
+            self.metrics_writer.write(
+                dict({f"{self.ROLE}/{k}": v
+                      for k, v in err.to_dict().items()
+                      if not isinstance(v, str)},
+                     reason=reason, trace_id=trace_id),
+                kind=f"{self.ROLE}_rejection")
+        raise err
+
+
+class ServingRouter(RouterBase):
     """Process-level router fronting N :class:`Replica` engines.
 
     ``slo``: the FLEET tracker (shared by every replica's engine so all
@@ -86,6 +135,7 @@ class ServingRouter:
                  clock: Callable[[], float] = time.monotonic):
         if not replicas:
             raise ValueError("need at least one replica")
+        super().__init__(metrics_writer=metrics_writer)
         self.replicas: List[Replica] = list(replicas)
         names = [r.name for r in self.replicas]
         if len(set(names)) != len(names):
@@ -93,14 +143,10 @@ class ServingRouter:
         self.slo = slo
         self.shed_burn_threshold = float(shed_burn_threshold)
         self.default_token_latency_ms = float(default_token_latency_ms)
-        self.metrics_writer = metrics_writer
         self._clock = clock
-        self._lock = threading.Lock()
-        self._ids = itertools.count()
         self._rr = 0                      # round-robin tie-breaker
         self._dispatched = 0
         self._dispatched_by: Dict[str, int] = {n: 0 for n in names}
-        self._rejected: Dict[str, int] = {r: 0 for r in REJECT_REASONS}
         self._affinity_hits = 0           # dispatches won by prefix len
         _flight.register_provider("router", self.introspect_state)
 
@@ -108,12 +154,14 @@ class ServingRouter:
     def submit(self, prompt, max_new_tokens: int, *,
                eos_id: Optional[int] = None,
                deadline_s: Optional[float] = None,
-               on_token=None) -> RequestHandle:
+               on_token=None, temperature: float = 0.0,
+               rng=None) -> RequestHandle:
         """Dispatch to the best replica or raise :class:`AdmissionError`
         with a machine-readable reason + ``retry_after_ms`` +
         ``queue_depth`` (the shape ``.to_dict()`` serializes for 429
-        bodies and the JSONL stream)."""
-        trace_id = f"req-{os.getpid():x}-rt{next(self._ids):08x}"
+        bodies and the JSONL stream).  ``temperature``/``rng`` ride the
+        hop unchanged (the engine enforces the sampling contract)."""
+        trace_id = self._mint_trace_id()
         t0_us = obs.now_us()
         loads = [r.load() for r in self.replicas]
         fleet_depth = sum(ld["queue_depth"] for ld in loads)
@@ -175,7 +223,8 @@ class ServingRouter:
         try:
             handle = rep.submit(prompt, max_new_tokens, eos_id=eos_id,
                                 deadline_s=deadline_s, on_token=on_token,
-                                trace_id=trace_id)
+                                trace_id=trace_id, temperature=temperature,
+                                rng=rng)
         except AdmissionError as e:
             # per-request races (another thread filled the queue) and
             # too_long both surface here; re-raise with the router's
@@ -205,25 +254,6 @@ class ServingRouter:
         est = min(ld["backlog_tokens"] * ms
                   for ld, ms in zip(loads, per_tok))
         return max(float(est), 1.0)
-
-    def _reject(self, reason: str, trace_id: str, detail: str, *,
-                retry_after_ms: float, queue_depth: int):
-        with self._lock:
-            self._rejected[reason] = self._rejected.get(reason, 0) + 1
-        err = AdmissionError(reason, detail,
-                             retry_after_ms=retry_after_ms,
-                             queue_depth=queue_depth)
-        obs.instant("router/rejected", cat="serving", reason=reason,
-                    trace_id=trace_id, queue_depth=queue_depth)
-        _flight.note("router", event="rejected", reason=reason,
-                     trace_id=trace_id, detail=detail)
-        if self.metrics_writer is not None:
-            self.metrics_writer.write(
-                dict({f"router/{k}": v for k, v in err.to_dict().items()
-                      if not isinstance(v, str)},
-                     reason=reason, trace_id=trace_id),
-                kind="router_rejection")
-        raise err
 
     # ---- driving ----
     def step(self) -> int:

@@ -100,7 +100,9 @@ class Request:
                  eos_id: Optional[int] = None,
                  deadline_t: Optional[float] = None,
                  on_token: Optional[Callable] = None,
-                 trace_id: Optional[str] = None):
+                 trace_id: Optional[str] = None,
+                 temperature: float = 0.0,
+                 rng=None):
         self.id = next(Request._ids)
         # pid disambiguates across engine restarts on one box; the
         # counter disambiguates within the process
@@ -111,6 +113,14 @@ class Request:
         self.eos_id = eos_id
         self.deadline_t = deadline_t      # absolute monotonic, or None
         self.on_token = on_token
+        # sampling plumbing (ISSUE 9): the lm_generate rng contract —
+        # ``temperature > 0`` requires an explicit per-request rng key
+        # (a (2,) uint32 PRNGKey, normalized by the frontend); greedy
+        # requests carry 0.0 and None.  Both ride the transfer wire
+        # unchanged so a disaggregated decode worker samples the exact
+        # tokens the fused engine would.
+        self.temperature = float(temperature)
+        self.rng = rng
         self.tokens: List[int] = []       # generated tokens, in order
         self.status = "queued"            # queued|running|done|evicted
         self.finish_reason: Optional[str] = None
@@ -207,10 +217,21 @@ class Scheduler:
         """Put an already-admitted request back at the queue HEAD
         (FIFO preserved) when its slot fell through — e.g. a sibling
         admission's prefix hit pinned the cached slot this one was
-        counting on scavenging.  Bypasses the capacity check: the
+        counting on scavenging, or a disaggregated transfer found no
+        destination (ISSUE 9).  Bypasses the capacity check: the
         request was already accepted once and must not be re-rejected."""
         with self._lock:
             self._queue.appendleft(req)
+
+    def drain(self) -> List[Request]:
+        """Remove and return every queued request, FIFO order — the
+        disagg router's dead-worker sweep re-dispatches (or sheds) a
+        victim's queue through this instead of stranding the handles
+        un-done forever."""
+        with self._lock:
+            out = list(self._queue)
+            self._queue.clear()
+        return out
 
     # ---- eviction ----
     def eviction_reason(self, req: Request, now: float) -> Optional[str]:

@@ -1,0 +1,442 @@
+"""KV-transfer plane: ship a finished prefill's KV slab between pools.
+
+The disaggregation primitive (ISSUE 9, ROADMAP item 4): a PREFILL
+worker computes a prompt's K/V slab into a staging slot of its own
+:class:`~chainermn_tpu.serving.cache_pool.CachePool`; this plane moves
+that slab — plus the request metadata riding with it — into a DECODE
+worker's reserved slot.  Two transports, one contract:
+
+* **Same-process** (:meth:`KvTransferPlane.transfer_local`): ONE
+  compiled program per (src-pool, dst-pool) shape pair — slot row out
+  of the source caches, through the PR 8 redistribution primitive
+  (``parallel/reshard.py::reshard``: each (src, dst) cache-spec pair
+  lowers to its MINIMAL collective — identity when both pools shard
+  the KV columns the same way, one accounted all_to_all if they ever
+  differ), ``dynamic_update_slice`` into the destination slot.  Slot
+  indices are traced operands, so every transfer after the first hits
+  the jit cache (the ``serving.kv_transfer`` analysis entry point
+  asserts one program across src/dst variants and reconciles its
+  collective bytes against the comm ledger).
+* **Cross-process** (:meth:`pack` → a DCN object lane →
+  :meth:`unpack_into`): the slab's written rows ``[0, pos)`` are
+  serialized with the request wire dict and shipped over the hardened
+  KV-store lanes (``communicators/base.py::lane_call`` — retry/backoff
+  on transients, loud :class:`~chainermn_tpu.communicators.base
+  .DcnLaneError` NAMING the lane otherwise), then injected through a
+  pool-lifetime compiled slab write on the receiving side.  Every lane
+  transfer books its RAW slab bytes in the comm ledger as a noted
+  ``kv_transfer_lane@dcn`` row — the same number
+  :func:`transfer_cost` predicts statically, held byte-exact by
+  tests/test_serving_disagg.py.
+
+Correctness of the full-row copy without a length operand: rows beyond
+the prompt's ``pos`` carry the source slot's stale K/V, but they land
+ABOVE the destination occupant's position and are unreachable by the
+standard per-slot masking argument (cache_pool.py module docstring) —
+the same reasoning that makes slot recycling and the prefix-cache copy
+exact, asserted token-exactly by the disagg fuzz tests.
+"""
+
+from __future__ import annotations
+
+import pickle
+import threading
+import time
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+#: Wire schema of one packed transfer (bump on layout change — a
+#: receiver must refuse a slab it cannot interpret, never guess).
+WIRE_SCHEMA = "chainermn_tpu.kv_transfer.v1"
+
+#: The ledger key every lane-mode transfer books under (op@axis) — the
+#: shard-flow/bench reconciliation joins on it.
+LANE_OP = "kv_transfer_lane"
+LANE_AXIS = "dcn"
+
+
+def _shard_axis_of(spec, axis_name: str) -> Optional[int]:
+    """The logical axis a pool's cache PartitionSpec shards over
+    ``axis_name`` — the glue into ``reshard``'s spec language (None =
+    replicated)."""
+    for i, s in enumerate(tuple(spec)):
+        names = s if isinstance(s, tuple) else (s,)
+        if axis_name in [n for n in names if n is not None]:
+            return i
+    return None
+
+
+def slab_nbytes(n_layers: int, length: int, kv_dim: int, dtype) -> int:
+    """RAW K/V payload bytes of one transferred slab: 2 (K and V) ×
+    layers × written rows × kv_dim — the ledger-convention number
+    (pickle framing excluded; the wire adds a few % on top)."""
+    item = np.dtype(dtype).itemsize
+    return 2 * int(n_layers) * int(length) * int(kv_dim) * item
+
+
+def transfer_cost(n_layers: int, length: int, kv_dim: int, dtype, *,
+                  mode: str, axis_size: int = 1,
+                  src_spec: Optional[int] = 2,
+                  dst_spec: Optional[int] = 2,
+                  copy_rows: Optional[int] = None) -> Dict[str, Any]:
+    """Static prediction of one transfer's comm-ledger booking — the
+    number the runtime must reproduce byte-exactly (the shard-flow
+    discipline applied to the transfer plane).
+
+    ``mode="local"``: the compiled same-process path — per-(K|V)-row
+    :func:`~chainermn_tpu.parallel.reshard.reshard_cost` of the
+    (1, copy_rows, kv_dim) block between the two pools' cache specs
+    (zero when they match, one all_to_all per row otherwise).
+    ``mode="lanes"``: the DCN object-lane path — :func:`slab_nbytes`
+    of the written rows, booked as one noted ``kv_transfer_lane@dcn``
+    row per transfer.
+    """
+    if mode == "lanes":
+        nbytes = slab_nbytes(n_layers, length, kv_dim, dtype)
+        return {"mode": mode, "primitive": LANE_OP,
+                "ledger_bytes": nbytes, "wire_bytes": nbytes,
+                "messages": 1}
+    if mode != "local":
+        raise ValueError(f"mode must be 'local' or 'lanes', got {mode!r}")
+    from ..parallel.reshard import reshard_cost
+
+    rows = int(copy_rows if copy_rows is not None else length)
+    total = {"mode": mode, "primitive": None, "ledger_bytes": 0,
+             "wire_bytes": 0, "messages": 0}
+    for _ in range(2 * int(n_layers)):
+        c = reshard_cost((1, rows, int(kv_dim)), dtype, src_spec,
+                         dst_spec, axis_size)
+        total["ledger_bytes"] += c["ledger_bytes"]
+        total["wire_bytes"] += c["wire_bytes"]
+        total["messages"] += c["messages"]
+        if c["primitive"]:
+            total["primitive"] = c["primitive"]
+    return total
+
+
+class InProcessLaneStore:
+    """Loopback object-lane transport: the single-process stand-in for
+    the jax.distributed KV store (``XlaCommunicator``'s client), with
+    the same put/get/delete face the cross-process deployment wires in.
+    Faults are injected through ``lane_call``'s injector, NOT here —
+    the chaos tests exercise the real retry/classification path."""
+
+    def __init__(self):
+        self._store: Dict[str, bytes] = {}
+        self._cv = threading.Condition()
+
+    def put(self, tag: str, payload: bytes) -> None:
+        with self._cv:
+            self._store[str(tag)] = bytes(payload)
+            self._cv.notify_all()
+
+    def get(self, tag: str, timeout_s: float = 10.0) -> bytes:
+        deadline = time.monotonic() + float(timeout_s)
+        with self._cv:
+            while str(tag) not in self._store:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    raise TimeoutError(
+                        f"kv transfer tag {tag!r} not published within "
+                        f"{timeout_s}s (deadline exceeded)")
+                self._cv.wait(left)
+            return self._store[str(tag)]
+
+    def delete(self, tag: str) -> None:
+        with self._cv:
+            self._store.pop(str(tag), None)
+
+
+class KvTransferPlane:
+    """The transfer-plane object a disaggregated fleet shares.
+
+    ``transport``: an object-lane (put/get/delete) for the cross-
+    process path — :class:`InProcessLaneStore` by default; a
+    multi-controller deployment passes the communicator-backed lanes
+    (``CommunicatorBase.kv_lane_transport()``).  The local compiled
+    path needs no transport and is used whenever source and
+    destination pools share a mesh.
+    """
+
+    def __init__(self, transport=None, lane_config=None):
+        self.transport = transport or InProcessLaneStore()
+        self.lane_config = lane_config
+        self._programs: Dict[Any, Any] = {}   # local-path program cache
+        self._inject_programs: Dict[Any, Any] = {}
+        # host-side counters (the fleet's /statusz + bench read these)
+        self.transfers = 0
+        self.lane_transfers = 0
+        self.bytes_moved = 0            # ledger-convention slab bytes
+        self.last_transfer_ms = 0.0
+
+    # ------------------------------------------------------------------
+    # same-process: one compiled program per pool-shape pair
+    # ------------------------------------------------------------------
+    def _local_key(self, src_pool, dst_pool):
+        def sig(pool):
+            return (pool.n_layers, pool.n_slots, pool.max_total,
+                    pool.kv_dim, str(pool.caches[0][0].dtype))
+        return (sig(src_pool), sig(dst_pool), id(src_pool.mesh),
+                id(dst_pool.mesh), src_pool.axis_name)
+
+    def _build_local(self, src_pool, dst_pool):
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        from .._compat import shard_map
+        from ..parallel.reshard import reshard
+
+        if src_pool.mesh is not dst_pool.mesh \
+                or src_pool.axis_name != dst_pool.axis_name:
+            raise ValueError(
+                "local transfer needs src and dst pools on ONE mesh/"
+                "axis; cross-mesh transfers go over the object lanes "
+                "(pack/unpack_into)")
+        if src_pool.kv_dim != dst_pool.kv_dim \
+                or src_pool.n_layers != dst_pool.n_layers:
+            raise ValueError(
+                f"pool shape mismatch: src (layers={src_pool.n_layers}, "
+                f"kv_dim={src_pool.kv_dim}) vs dst "
+                f"(layers={dst_pool.n_layers}, kv_dim={dst_pool.kv_dim})")
+        axis = src_pool.axis_name
+        copy_rows = min(src_pool.max_total, dst_pool.max_total)
+        s_spec = _shard_axis_of(src_pool.cache_spec, axis)
+        d_spec = _shard_axis_of(dst_pool.cache_spec, axis)
+        src_specs = [(src_pool.cache_spec, src_pool.cache_spec)
+                     for _ in range(src_pool.n_layers)]
+        dst_specs = [(dst_pool.cache_spec, dst_pool.cache_spec)
+                     for _ in range(dst_pool.n_layers)]
+
+        def body(src_caches, dst_caches, src_slot, dst_slot):
+            out = []
+            for (ks, vs), (kd, vd) in zip(src_caches, dst_caches):
+                k_row = jax.lax.dynamic_index_in_dim(ks, src_slot, axis=0,
+                                                     keepdims=True)
+                v_row = jax.lax.dynamic_index_in_dim(vs, src_slot, axis=0,
+                                                     keepdims=True)
+                k_row = k_row[:, :copy_rows]
+                v_row = v_row[:, :copy_rows]
+                # the portable redistribution primitive: identity while
+                # both pools shard the KV columns identically, the
+                # minimal accounted collective the moment they differ
+                k_row = reshard(k_row, s_spec, d_spec, axis)
+                v_row = reshard(v_row, s_spec, d_spec, axis)
+                start = (dst_slot, 0, 0)
+                out.append(
+                    (jax.lax.dynamic_update_slice(
+                        kd, k_row.astype(kd.dtype), start),
+                     jax.lax.dynamic_update_slice(
+                        vd, v_row.astype(vd.dtype), start)))
+            return out
+
+        return jax.jit(shard_map(
+            body, mesh=src_pool.mesh,
+            in_specs=(src_specs, dst_specs, P(), P()),
+            out_specs=dst_specs))
+
+    def local_program(self, src_pool, dst_pool):
+        """The compiled (src-pool, dst-pool) transfer program — cached;
+        the analysis entry point probes it for recompiles."""
+        key = self._local_key(src_pool, dst_pool)
+        prog = self._programs.get(key)
+        if prog is None:
+            prog = self._programs[key] = self._build_local(src_pool,
+                                                           dst_pool)
+            from ..observability import flight as _flight
+            _flight.note("compile", program="serving_kv_transfer",
+                         family_size=len(self._programs))
+        return prog
+
+    def transfer_local(self, src_pool, src_slot: int, dst_pool,
+                       dst_slot: int, length: int) -> Dict[str, Any]:
+        """Move slot ``src_slot``'s slab into ``dst_slot`` on the same
+        mesh and set ``dst_pool.pos[dst_slot] = length``.  Returns the
+        transfer stats row (mode, ms, ledger bytes)."""
+        import jax.numpy as jnp
+
+        copy_rows = min(src_pool.max_total, dst_pool.max_total)
+        if not (0 < int(length) <= copy_rows):
+            raise ValueError(
+                f"transfer length {length} out of range (0, {copy_rows}] "
+                f"(src max_total {src_pool.max_total}, dst "
+                f"{dst_pool.max_total})")
+        prog = self.local_program(src_pool, dst_pool)
+        t0 = time.monotonic()
+        dst_pool.caches = prog(src_pool.caches, dst_pool.caches,
+                               jnp.int32(src_slot), jnp.int32(dst_slot))
+        dst_pool.pos[dst_slot] = int(length)
+        ms = (time.monotonic() - t0) * 1e3
+        self.transfers += 1
+        self.last_transfer_ms = ms
+        axis = src_pool.axis_name
+        cost = transfer_cost(
+            src_pool.n_layers, length, src_pool.kv_dim,
+            src_pool.caches[0][0].dtype, mode="local",
+            axis_size=src_pool.mesh.shape[axis],
+            src_spec=_shard_axis_of(src_pool.cache_spec, axis),
+            dst_spec=_shard_axis_of(dst_pool.cache_spec, axis),
+            copy_rows=copy_rows)
+        return {"mode": "local", "ms": ms,
+                "ledger_bytes": cost["ledger_bytes"],
+                "length": int(length)}
+
+    # ------------------------------------------------------------------
+    # cross-process: pack -> object lane -> unpack_into
+    # ------------------------------------------------------------------
+    def pack(self, src_pool, src_slot: int, length: int,
+             meta: Dict[str, Any]) -> bytes:
+        """Serialize slot ``src_slot``'s written rows ``[0, length)``
+        plus the request wire dict.  Host-side numpy throughout — the
+        payload is transport-agnostic bytes."""
+        import jax
+
+        if not (0 < int(length) <= src_pool.max_total):
+            raise ValueError(f"pack length {length} out of range "
+                             f"(0, {src_pool.max_total}]")
+        rows = []
+        for kc, vc in src_pool.caches:
+            rows.append((np.asarray(jax.device_get(kc[src_slot, :length])),
+                         np.asarray(jax.device_get(vc[src_slot, :length]))))
+        return pickle.dumps({
+            "schema": WIRE_SCHEMA,
+            "meta": dict(meta),
+            "pos": int(length),
+            "n_layers": src_pool.n_layers,
+            "kv_dim": src_pool.kv_dim,
+            "dtype": str(rows[0][0].dtype),
+            "rows": rows,
+        }, protocol=pickle.HIGHEST_PROTOCOL)
+
+    def lane_put(self, tag: str, payload: bytes) -> None:
+        """Publish a packed slab on the object lane, under the hardened
+        retry discipline — the flight ring records every retry and the
+        terminal fault NAMES the lane (``kv_transfer/put/<tag>``)."""
+        from ..communicators.base import lane_call
+
+        lane_call(f"kv_transfer/put/{tag}",
+                  lambda: self.transport.put(tag, payload),
+                  self.lane_config)
+
+    def lane_get(self, tag: str, timeout_s: float = 10.0) -> bytes:
+        from ..communicators.base import lane_call
+
+        return lane_call(
+            f"kv_transfer/get/{tag}",
+            lambda: self.transport.get(tag, timeout_s),
+            self.lane_config)
+
+    def lane_delete(self, tag: str) -> None:
+        from ..communicators.base import lane_call
+
+        lane_call(f"kv_transfer/gc/{tag}",
+                  lambda: self.transport.delete(tag), self.lane_config)
+
+    def unpack_into(self, payload: bytes, dst_pool,
+                    dst_slot: int) -> Dict[str, Any]:
+        """Inject a packed slab into ``dst_slot`` (compiled pool-
+        lifetime slab write; the host pads the slab to the pool row so
+        the program needs no length operand) and book the RAW slab
+        bytes as a noted ``kv_transfer_lane@dcn`` ledger row — the
+        exact :func:`transfer_cost(mode="lanes")` prediction.  Returns
+        the wire dict's ``meta`` + transfer stats."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        from .._compat import shard_map
+
+        t0 = time.monotonic()
+        data = pickle.loads(payload)
+        if data.get("schema") != WIRE_SCHEMA:
+            raise ValueError(
+                f"refusing KV transfer with schema "
+                f"{data.get('schema')!r} (this receiver speaks "
+                f"{WIRE_SCHEMA})")
+        if data["n_layers"] != dst_pool.n_layers \
+                or data["kv_dim"] != dst_pool.kv_dim:
+            raise ValueError(
+                f"slab shape mismatch: wire (layers={data['n_layers']}, "
+                f"kv_dim={data['kv_dim']}) vs pool "
+                f"(layers={dst_pool.n_layers}, kv_dim={dst_pool.kv_dim})")
+        length = int(data["pos"])
+        if length > dst_pool.max_total:
+            raise ValueError(
+                f"slab length {length} exceeds destination per-slot "
+                f"capacity {dst_pool.max_total}")
+
+        key = (dst_pool.n_layers, dst_pool.n_slots, dst_pool.max_total,
+               dst_pool.kv_dim, str(dst_pool.caches[0][0].dtype),
+               id(dst_pool.mesh))
+        prog = self._inject_programs.get(key)
+        if prog is None:
+            dst_specs = [(dst_pool.cache_spec, dst_pool.cache_spec)
+                         for _ in range(dst_pool.n_layers)]
+            # a slab row is the cache row minus the slot dim: same
+            # column sharding, one rank lower
+            row_spec = P(*tuple(dst_pool.cache_spec)[1:])
+            slab_specs = [(row_spec, row_spec)
+                          for _ in range(dst_pool.n_layers)]
+
+            def body(dst_caches, slabs, dst_slot):
+                out = []
+                for (kd, vd), (ks, vs) in zip(dst_caches, slabs):
+                    start = (dst_slot, 0, 0)
+                    out.append(
+                        (jax.lax.dynamic_update_slice(
+                            kd, ks[None].astype(kd.dtype), start),
+                         jax.lax.dynamic_update_slice(
+                            vd, vs[None].astype(vd.dtype), start)))
+                return out
+
+            prog = self._inject_programs[key] = jax.jit(shard_map(
+                body, mesh=dst_pool.mesh,
+                in_specs=(dst_specs, slab_specs, P()),
+                out_specs=dst_specs))
+            from ..observability import flight as _flight
+            _flight.note("compile", program="serving_kv_inject")
+        # pad each layer's rows to the pool row (rows above ``length``
+        # are stale-but-unreachable, the standard masking argument)
+        slabs = []
+        dt = dst_pool.caches[0][0].dtype
+        for k, v in data["rows"]:
+            kp = np.zeros((dst_pool.max_total, dst_pool.kv_dim),
+                          np.asarray(k).dtype)
+            vp = np.zeros_like(kp)
+            kp[:length] = k
+            vp[:length] = v
+            slabs.append((jnp.asarray(kp.astype(dt)),
+                          jnp.asarray(vp.astype(dt))))
+        dst_pool.caches = prog(dst_pool.caches, slabs,
+                               jnp.int32(dst_slot))
+        dst_pool.pos[dst_slot] = length
+
+        nbytes = slab_nbytes(data["n_layers"], length, data["kv_dim"],
+                             data["dtype"])
+        ms = (time.monotonic() - t0) * 1e3
+        self.transfers += 1
+        self.lane_transfers += 1
+        self.bytes_moved += nbytes
+        self.last_transfer_ms = ms
+        # comm-ledger booking (the acceptance contract: every transfer
+        # priced, byte-exact vs transfer_cost) — noted, like the
+        # AD-inserted gradient psum: traffic no collective wrapper sees
+        from ..observability import comm as _comm
+        from ..observability import trace as _trace
+        if _trace.get_tracer().enabled:
+            _comm.get_accountant().record(
+                LANE_OP, LANE_AXIS, nbytes, data["dtype"],
+                in_jit=False, latency_s=ms / 1e3, noted=True)
+        return {"mode": "lanes", "ms": ms, "ledger_bytes": nbytes,
+                "wire_payload_bytes": len(payload), "length": length,
+                "meta": data["meta"]}
+
+    def stats(self) -> Dict[str, float]:
+        return {
+            "transfers": float(self.transfers),
+            "lane_transfers": float(self.lane_transfers),
+            "bytes_moved": float(self.bytes_moved),
+            "last_transfer_ms": float(self.last_transfer_ms),
+            "programs": float(len(self._programs)
+                              + len(self._inject_programs)),
+        }

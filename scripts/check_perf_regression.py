@@ -67,11 +67,13 @@ _SKIP = re.compile(
 #: dropped-event and rejected-request tallies are failure tallies — more
 #: is worse (rejected: the serving engine's backpressure counter;
 #: shed: the router's SLO-aware load shedding — a higher shed rate at
-#: the same offered load means less goodput).
+#: the same offered load means less goodput; variance/requeue: the
+#: disagg bench's tick-gap spread and transfer-backpressure requeues —
+#: both rise when prefill interference leaks back in, ISSUE 9).
 _LOWER = re.compile(
     r"(time|_ms|ms_|/ms$|^ms$|latency|seconds|_s$|/s$|bytes|loss|"
     r"step_ms|gap|slowdown|imbalance|drift|anomal|dropped|findings|"
-    r"rejected|shed|steps_to_recover)",
+    r"rejected|shed|steps_to_recover|variance|requeue)",
     re.IGNORECASE)
 
 
