@@ -953,6 +953,186 @@ def bench_serving_disagg():
     }
 
 
+def bench_serving_chaos():
+    """Serving-fleet chaos perf (ISSUE 10, docs/ROBUSTNESS.md "Serving
+    failure domains"): what a worker death and a rolling drain actually
+    cost, on the gate.
+
+    A 2-worker cross-process-protocol fleet (in-process runtimes over
+    the loopback lanes — the REAL mailbox/lease/fencing/failover code,
+    no spawn cost) under steady offered load:
+
+    * ``steady_tokens_per_sec`` — pre-fault baseline.
+    * ``detection_ms`` — kill one worker mid-decode (heartbeats stop
+      dead, exactly a SIGKILL's signature); wall until the supervisor
+      marks it dead.  Bounded by ``detection_window_ms`` = beat ×
+      (miss_beats + 1).
+    * ``failover_ttft_p99_ms`` — TTFT of re-dispatched requests,
+      measured from ORIGINAL submit (the failover penalty).
+    * ``kill_shed_rate`` — requests shed during the kill window at the
+      same offered load (failover should hold it near 0 with a live
+      survivor).
+    * ``kill_recovery_s`` — wall from the kill until the backlog fully
+      drains on the survivor.
+    * ``drain_shed`` / ``drain_recovery_frac`` — graceful rolling
+      restart: drain a worker (must shed NOTHING, exit cleanly), admit
+      a replacement, and the fleet's tokens/s recovers to within 10% of
+      the pre-drain steady state (the acceptance bound).
+
+    Every-backend contract; ``detection``/``failover``/``shed``/
+    ``recovery_s`` keys gate lower-is-better, ``drain_recovery_frac``
+    higher, in bench_history.jsonl.
+    """
+    import threading
+
+    import jax
+    import numpy as np
+
+    import chainermn_tpu as mn
+    from chainermn_tpu.parallel import init_tp_transformer_lm
+    from chainermn_tpu.serving import AdmissionError
+    from chainermn_tpu.serving.fleet import (WorkerClient,
+                                             build_local_fleet,
+                                             submit_with_retry)
+    from chainermn_tpu.serving.worker import WorkerRuntime
+
+    vocab, d_model, n_heads, n_layers = 128, 32, 4, 2
+    s_p, new, n_requests = 16, 12, 12
+    submit_every_s = 0.008
+    params = init_tp_transformer_lm(
+        jax.random.PRNGKey(0), vocab, d_model, n_heads, n_layers,
+        max_len=s_p + new, pos_impl="rope")
+    mesh = mn.make_nd_mesh(("model",), (1,), jax.devices()[:1])
+    rs = np.random.RandomState(0)
+    prompts = [rs.randint(0, vocab, s_p).astype(np.int32)
+               for _ in range(n_requests)]
+    wk = dict(n_slots=4, max_total=s_p + new, queue_capacity=n_requests,
+              mesh=mesh)
+
+    router, runtimes = build_local_fleet(
+        params, {"engine": 2}, head_dim=d_model // n_heads,
+        beat_interval_s=0.02, miss_beats=4, worker_kwargs=wk)
+    threads = [threading.Thread(target=rt.run, daemon=True)
+               for rt in runtimes]
+    for t in threads:
+        t.start()
+    router.start()
+
+    def offer(n, shed_box):
+        handles = []
+        for i in range(n):
+            try:
+                handles.append(submit_with_retry(
+                    router.submit, prompts[i % n_requests], new,
+                    max_attempts=3))
+            except AdmissionError:
+                shed_box[0] += 1
+            time.sleep(submit_every_s)
+        return handles
+
+    def wait_done(handles, timeout=60):
+        t0 = time.time()
+        while (any(h.status not in ("done", "evicted") for h in handles)
+               and time.time() - t0 < timeout):
+            time.sleep(0.005)
+
+    # warm every worker's compiles, then the steady baseline
+    warm = offer(4, [0])
+    wait_done(warm)
+    router.reset_stats()
+    shed = [0]
+    t0 = time.time()
+    handles = offer(n_requests, shed)
+    wait_done(handles)
+    steady_s = time.time() - t0
+    steady_tps = sum(len(h.tokens) for h in handles) / max(steady_s, 1e-9)
+
+    # --- kill one worker mid-decode under live load ---
+    router.reset_stats()
+    kill_shed = [0]
+    t_kill = [None]
+
+    def kill_midway():
+        time.sleep(submit_every_s * 3)
+        t_kill[0] = time.time()
+        runtimes[0].kill()
+
+    killer = threading.Thread(target=kill_midway)
+    killer.start()
+    handles = offer(n_requests, kill_shed)
+    killer.join()
+    wait_done(handles)
+    kill_recovery_s = time.time() - t_kill[0]
+    m = router.metrics()
+    terminal = sum(h.status in ("done", "evicted") for h in handles)
+    kill_shed_total = kill_shed[0] + int(
+        m.get("fleet/shed_inflight_total", 0))
+
+    # --- graceful rolling restart: drain the survivor's sibling -------
+    # admit a replacement first so capacity survives the drain
+    replacement = WorkerRuntime("engine2", "engine", params,
+                                router.store,
+                                head_dim=d_model // n_heads, epoch=1,
+                                beat_interval_s=0.02, **wk)
+    rthread = threading.Thread(target=replacement.run, daemon=True)
+    rthread.start()
+    router.add_worker(WorkerClient("engine2", "engine", router.store,
+                                   epoch=1))
+    runtimes.append(replacement)
+    threads.append(rthread)
+    pre_drain_tps = steady_tps
+    m_pre = router.metrics()
+    shed_before = (int(m_pre.get("fleet/shed_inflight_total", 0))
+                   + int(m_pre.get("fleet/rejected_total", 0)))
+    router.drain("engine1")
+    drained = router.wait_drained("engine1", timeout_s=30)
+    m_post = router.metrics()
+    drain_shed = (int(m_post.get("fleet/shed_inflight_total", 0))
+                  + int(m_post.get("fleet/rejected_total", 0))
+                  - shed_before)
+    # warm the replacement's programs outside the measured window
+    warm = offer(2, [0])
+    wait_done(warm)
+    router.reset_stats()
+    t0 = time.time()
+    post_shed = [0]
+    handles = offer(n_requests, post_shed)
+    wait_done(handles)
+    post_s = time.time() - t0
+    post_tps = sum(len(h.tokens) for h in handles) / max(post_s, 1e-9)
+
+    router.stop()
+    for rt in runtimes:
+        rt.finished = True
+    for t in threads:
+        t.join(timeout=5)
+    router.close()
+
+    return {
+        "config": f"2 engine workers (+1 replacement), d{d_model} "
+                  f"L{n_layers} V{vocab} prompt{s_p} new{new} "
+                  f"x{n_requests}, beat 20ms × miss 4, loopback lanes",
+        "steady_tokens_per_sec": round(steady_tps, 1),
+        "detection_ms": round(m.get("fleet/detection_ms", 0.0), 1),
+        "detection_window_ms": round(router.lease_window_s * 1e3, 1),
+        "failover_ttft_p99_ms": round(
+            m.get("fleet/failover_ttft_p99_ms", 0.0), 2),
+        "redispatched": int(m.get("fleet/redispatched_total", 0)),
+        "kill_shed_rate": round(
+            kill_shed_total / max(n_requests, 1), 4),
+        "kill_terminal_frac": round(terminal / max(n_requests, 1), 4),
+        "kill_recovery_s": round(kill_recovery_s, 3),
+        "drain_completed": bool(drained),
+        "drain_shed": max(drain_shed, 0) if drained else None,
+        "post_drain_tokens_per_sec": round(post_tps, 1),
+        "drain_recovery_frac": round(
+            post_tps / max(pre_drain_tps, 1e-9), 4),
+        "fenced_refusals": int(sum(
+            v for k, v in m.items()
+            if k.startswith("fleet/fenced_refusals/"))),
+    }
+
+
 def bench_elastic_resume():
     """Elastic/preemption robustness perf (ISSUE 8, docs/ROBUSTNESS.md):
     what fault tolerance actually costs, on the gate.
@@ -1590,6 +1770,7 @@ def main():
         "serving": None,
         "serving_router": None,
         "serving_disagg": None,
+        "serving_chaos": None,
         "data_path": None,
         "long_context": None,
         "projected_scaling": projected,
@@ -1639,6 +1820,10 @@ def main():
                                       "tick_gap_p99_ms"),
             "disagg_gap_p99_1_1": g(result, "serving_disagg",
                                     "disagg_1_1", "tick_gap_p99_ms"),
+            "chaos_detection_ms": g(result, "serving_chaos",
+                                    "detection_ms"),
+            "chaos_drain_recovery": g(result, "serving_chaos",
+                                      "drain_recovery_frac"),
             "flash_s8192_mfu": g(result, "long_context",
                                  "flash_fwd_bwd_S8192", "attn_mfu"),
             "flash_s16384_mfu": g(result, "long_context",
@@ -1795,6 +1980,22 @@ def main():
             emit()
     else:
         print("bench: over budget — serving_disagg section skipped",
+              file=sys.stderr)
+
+    # --- serving chaos: worker death + rolling drain cost (ISSUE 10) -------
+    # Every-backend contract; detection/failover/shed/recovery keys gate
+    # lower-is-better (drain_recovery_frac higher) in bench_history.jsonl
+    # — the acceptance bound is drain_recovery_frac >= 0.9.
+    if not over_budget():
+        try:
+            result["serving_chaos"] = bench_serving_chaos()
+            emit("serving_chaos")
+        except Exception as e:
+            print(f"bench: serving_chaos section failed: {e!r}",
+                  file=sys.stderr)
+            emit()
+    else:
+        print("bench: over budget — serving_chaos section skipped",
               file=sys.stderr)
 
     # --- elastic resume: checkpoint/reshard/preemption cost (ISSUE 8) ------

@@ -601,6 +601,154 @@ def _build_demo_train_step() -> Dict[str, Any]:
             }}
 
 
+class _SupervisedTickProbe:
+    """Variant probe for the SUPERVISED tick (ISSUE 10): every call
+    runs under the scoped tracer+tee state AND one full supervision-
+    plane round — heartbeat lease publish, supervisor-side lease read +
+    epoch-fence admission, circuit-breaker consult — the host path a
+    fleet worker's device call lives under in production.  The health
+    plane must add ZERO device traffic and ZERO compiles."""
+
+    def __init__(self, jfn, plane):
+        self._jfn = jfn
+        self._plane = plane   # (publisher, table, fence, breaker)
+
+    def __call__(self, *a):
+        from chainermn_tpu import observability as obs
+        from chainermn_tpu.observability import flight
+        pub, table, fence, breaker = self._plane
+        with _traced_obs_state():
+            pub.beat(queue_depth=0, free_slots=1, busy_slots=1)
+            with obs.span("serving/tick", cat="serving"):
+                out = self._jfn(*a)
+            lease = table.read("analysis-worker")
+            fence.admit("analysis-worker", lease["epoch"], "lease")
+            breaker.allow()
+            flight.note("fleet", event="supervisor_tick",
+                        worker="analysis-worker",
+                        lease_seq=lease["seq"])
+            flight.note("phase", name="fleet/supervise")
+        return out
+
+    def _cache_size(self):
+        return self._jfn._cache_size()
+
+
+def _build_supervisor_tick() -> Dict[str, Any]:
+    """The serving decode tick as a SUPERVISED fleet worker runs it
+    (ISSUE 10): heartbeat publish on the loopback lane store, lease
+    read + epoch-fence admission + breaker consult on the supervisor
+    side, tracer + flight tee installed — all host-side bookkeeping.
+    One program across value variants: liveness must never leak into
+    trace-time."""
+    from chainermn_tpu.serving.health import (CircuitBreaker, EpochFence,
+                                              HeartbeatPublisher,
+                                              LeaseTable)
+    from chainermn_tpu.serving.transfer import InProcessLaneStore
+
+    base = _build_decode_tick()
+    fn, args = base["trace"]
+    store = InProcessLaneStore()
+    fence = EpochFence()
+    epoch = fence.new_epoch("analysis-worker")
+    plane = (HeartbeatPublisher(store, "analysis-worker", "engine", epoch),
+             LeaseTable(store), fence, CircuitBreaker())
+    probe = _SupervisedTickProbe(base["variants"][0], plane)
+
+    def run_supervised(*a):
+        return probe(*a)
+
+    return {"trace": (run_supervised, args),
+            "bound_axes": base["bound_axes"],
+            "variants": (probe, base["variants"][1])}
+
+
+class _WorkerLaneProbe:
+    """Variant probe for the lane LANDING program (ISSUE 10): every
+    call runs one worker-lane mailbox round trip (pickled control
+    message out, consumed in order on the receiver side) around the
+    compiled slab write — the cross-process protocol's host path.  The
+    mailbox hop must add zero device traffic and zero compiles."""
+
+    def __init__(self, jfn, sender, receiver):
+        self._jfn = jfn
+        self._sender = sender
+        self._receiver = receiver
+
+    def __call__(self, *a):
+        from chainermn_tpu.observability import flight
+        with _traced_obs_state():
+            self._sender.send({"kind": "install", "epoch": 1,
+                               "trace_id": "req-analysis-wl00000000",
+                               "tag": "slab/req-analysis-wl00000000"})
+            msg = self._receiver.recv()
+            out = self._jfn(*a)
+            flight.note("worker", event="installed",
+                        worker="analysis-decode0",
+                        trace_id=msg["trace_id"])
+            flight.note("phase", name="worker/step")
+        return out
+
+    def _cache_size(self):
+        return self._jfn._cache_size()
+
+
+def _build_worker_lane() -> Dict[str, Any]:
+    """The worker lane protocol's device half (ISSUE 10): the
+    pool-lifetime compiled slab INJECT program
+    (:meth:`KvTransferPlane.inject_program`) that lands every
+    cross-process transfer, run under one mailbox round trip per call.
+    Contract: pure data movement — ZERO collectives (each TP rank
+    writes its local KV columns; held to an empty ledger by the comm
+    reconciliation) and ONE compiled program across (slab values, dst
+    slot) variants."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from chainermn_tpu.serving.cache_pool import CachePool
+    from chainermn_tpu.serving.lanes import (MailboxReceiver,
+                                             MailboxSender)
+    from chainermn_tpu.serving.transfer import (InProcessLaneStore,
+                                                KvTransferPlane)
+
+    params, specs, mesh = _tiny_lm()
+    head_dim = 4
+    n_kv = 2  # _tiny_lm: 2 heads, no GQA
+    dtype = params["embed"].dtype
+    pool = CachePool(2, 8, 1, n_kv * head_dim, dtype, mesh, "model")
+    plane = KvTransferPlane()
+    jfn = plane.inject_program(pool)
+
+    rng = np.random.RandomState(_SEED)
+    slab = [(jnp.asarray(rng.randn(8, n_kv * head_dim).astype(dtype)),
+             jnp.asarray(rng.randn(8, n_kv * head_dim).astype(dtype)))]
+    store = InProcessLaneStore()
+    probe = _WorkerLaneProbe(
+        jfn, MailboxSender(store, "ctl.analysis-decode0"),
+        MailboxReceiver(store, "ctl.analysis-decode0"))
+
+    def run(caches, slabs, dst):
+        return probe(caches, slabs, dst)
+
+    args0 = (pool.caches, slab, jnp.int32(0))
+    variants = (probe, [
+        args0,
+        (pool.caches, slab, jnp.int32(1)),
+    ])
+    return {"trace": (run, args0),
+            "bound_axes": {"model"},
+            "variants": variants,
+            "data_axis": "model",
+            "arg_labels": ("dst_caches", "slabs", "dst"),
+            # dst_caches/slabs thread in SHARDED (P(None, None, model) /
+            # P(None, model)); only the host-fed slot scalar replicates
+            "expected_replication": {
+                "dst": "destination (reserved) slot index: one host-fed "
+                       "int32 scalar per landing, replicated to every "
+                       "TP rank by design",
+            }}
+
+
 def select_entrypoints(names=None, for_shardflow: bool = False):
     """Resolve ``--entry`` names against the registry — the ONE resolver
     both runners share (``cli.py`` and ``shardflow.main``).
@@ -692,6 +840,24 @@ ENTRYPOINTS = [
                     "program across (src, dst) slot variants, identity "
                     "reshard at matching pool specs — zero collectives, "
                     "bytes ledger-reconciled (ISSUE 9)"),
+    EntryPoint(
+        name="serving.supervisor_tick",
+        build=_build_supervisor_tick,
+        shardflow=False,  # same compiled program as the decode tick —
+        #                   the base entry owns its shard-flow analysis
+        description="serving decode tick under the fleet supervision "
+                    "plane: heartbeat lease publish + supervisor lease "
+                    "read + epoch-fence admission + breaker consult — "
+                    "liveness is host-side bookkeeping: one program, "
+                    "zero extra device traffic (ISSUE 10)"),
+    EntryPoint(
+        name="serving.worker_lane",
+        build=_build_worker_lane,
+        description="cross-process worker lane landing program "
+                    "(KvTransferPlane.inject_program) under a mailbox "
+                    "round trip per call: zero collectives, one "
+                    "compiled program across (slab, dst slot) variants "
+                    "(ISSUE 10)"),
     EntryPoint(
         name="serving.tick_with_tracing",
         build=_build_tick_with_tracing,

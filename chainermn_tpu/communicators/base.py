@@ -345,7 +345,13 @@ class CommunicatorBase:
         classification discipline and the flight ring NAMES the lane.
         Single-controller backends loop back through one in-process
         store; multi-controller backends override with the
-        jax.distributed KV store."""
+        jax.distributed KV store.  NOTE the gang-membership caveat
+        (ISSUE 10): the jax.distributed store requires every process
+        inside ONE fixed-size runtime — an ELASTIC serving fleet whose
+        members die, drain, and join independently uses
+        ``chainermn_tpu.serving.lanes.FileLaneStore`` instead (same
+        put/get/delete face over a shared directory), keeping this
+        transport for gangs that already share a coordinator."""
         store = getattr(self, "_kv_lane_store", None)
         if store is None:
             from ..serving.transfer import InProcessLaneStore

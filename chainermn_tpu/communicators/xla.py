@@ -497,7 +497,11 @@ class XlaCommunicator(CommunicatorBase):
         idempotent-set discipline the checkpoint lanes use.  The raw
         store ops raise freely — the transfer plane wraps each call in
         ``lane_call``, which classifies, retries, and names the lane.
-        Single-process falls back to the in-process loopback store."""
+        Single-process falls back to the in-process loopback store.
+        Fleets that must outlive their members (workers SIGKILLed,
+        drained, re-admitted — ISSUE 10) use the coordinator-free
+        ``serving.lanes.FileLaneStore`` with the same face instead:
+        this store dies with the jax.distributed coordinator."""
         if not self._multiprocess():
             return super().kv_lane_transport()
         comm = self

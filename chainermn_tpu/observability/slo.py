@@ -121,8 +121,12 @@ class GoodputLedger:
     #: disaggregated serving path (prefill worker → decode worker) —
     #: its own bucket so the P:D tuning loop sees what the transfer
     #: plane costs instead of it hiding inside ``host``.
+    #: ``supervise`` (ISSUE 10): the fleet router's health-plane wall —
+    #: lease reads, death detection, failover bookkeeping — booked so
+    #: the supervision tax on the dispatch loop is visible, not hidden
+    #: in ``host``.
     BUCKETS = ("compute", "comm", "host", "compile", "queue_wait", "stall",
-               "checkpoint", "transfer")
+               "checkpoint", "transfer", "supervise")
 
     def __init__(self, wall_clock: Callable[[], float] = time.monotonic):
         self._clock = wall_clock

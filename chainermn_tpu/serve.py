@@ -23,16 +23,26 @@ shedding, fleet-wide metrics/statusz — the summary then carries the
 ``router/*`` keys (per-reason rejection counters included) and the
 JSONL stream gains ``router_rejection``/``router_summary`` records.
 
+``--fleet-procs N`` (ISSUE 10) spawns N engine workers as separate
+PROCESSES over the file lanes, supervised by the heartbeat/lease health
+plane (death detection, in-flight failover, zombie fencing); the demo's
+load generator honors ``retry_after_ms`` via ``submit_with_retry``, and
+the run ends with a graceful rolling drain (every worker exits 0 —
+asserted in the summary's ``fleet_exit_codes``).  ``--disagg P:D
+--procs`` runs the role-split workers cross-process the same way.
+
 Run:  python -m chainermn_tpu.serve --devices 8 --tp 2
       python -m chainermn_tpu.serve --steps-budget 40 --requests 8 \
           --metrics-out /tmp/serve.jsonl --prom-out /tmp/serve.prom
       python -m chainermn_tpu.serve --replicas 2 --requests 12
+      python -m chainermn_tpu.serve --fleet-procs 2 --requests 8
 """
 
 import argparse
 import json
 import os
 import sys
+import time
 
 
 def make_corpus(rng, n, seq_len, vocab):
@@ -85,6 +95,30 @@ def main(argv=None):
                         help="disagg KV-transfer transport: 'local' = "
                              "the compiled reshard path, 'lanes' = the "
                              "DCN object lanes (ledger-booked bytes)")
+    parser.add_argument("--fleet-procs", type=int, default=0,
+                        help="cross-PROCESS fleet (ISSUE 10): spawn N "
+                             "engine workers as separate processes over "
+                             "the file lanes, supervised by the "
+                             "heartbeat/lease health plane with "
+                             "in-flight failover; mutually exclusive "
+                             "with --replicas > 1 / --disagg")
+    parser.add_argument("--procs", action="store_true",
+                        help="with --disagg P:D: run the role workers "
+                             "as separate PROCESSES over the lanes "
+                             "instead of in-process (ISSUE 10)")
+    parser.add_argument("--lane-dir", default=None,
+                        help="directory for the cross-process file "
+                             "lanes (default: a fresh temp dir)")
+    parser.add_argument("--beat-interval-s", type=float, default=0.05,
+                        help="worker heartbeat interval; the router "
+                             "declares death after miss_beats=4 missed "
+                             "beats (detection window "
+                             "= beat * (4+1); docs/ROBUSTNESS.md)")
+    parser.add_argument("--submit-retries", type=int, default=3,
+                        help="client-side submit attempts: shed/full "
+                             "rejections honor retry_after_ms with "
+                             "jittered backoff before giving up "
+                             "machine-readably (submit_with_retry)")
     parser.add_argument("--temperature", type=float, default=0.0,
                         help="per-request sampling temperature (0 = "
                              "greedy); >0 samples under the lm_generate "
@@ -209,6 +243,8 @@ def main(argv=None):
         mesh=serve_mesh, queue_capacity=args.queue_capacity)
     router = None
     disagg = None
+    fleet = None
+    n_p = n_d = 0
     if args.disagg:
         if args.replicas > 1:
             raise SystemExit("--disagg and --replicas > 1 are mutually "
@@ -221,6 +257,31 @@ def main(argv=None):
         if n_p < 1 or n_d < 1:
             raise SystemExit(f"--disagg needs at least one worker per "
                              f"role, got {args.disagg!r}")
+    if args.fleet_procs or (args.disagg and args.procs):
+        # cross-PROCESS fleet (ISSUE 10): every worker a separate
+        # process over the file lanes, supervised by the lease plane
+        if args.fleet_procs and (args.replicas > 1 or args.disagg):
+            raise SystemExit("--fleet-procs is mutually exclusive with "
+                             "--replicas > 1 / --disagg")
+        import tempfile
+        from chainermn_tpu.serving.fleet import build_proc_fleet
+        topology = ({"engine": args.fleet_procs} if args.fleet_procs
+                    else {"prefill": n_p, "decode": n_d})
+        lane_dir = args.lane_dir or tempfile.mkdtemp(
+            prefix="chainermn_tpu_lanes_")
+        fleet = build_proc_fleet(
+            trained, topology, lane_dir, head_dim=head_dim,
+            beat_interval_s=args.beat_interval_s,
+            bundle_dir=args.flight_dump_dir,
+            worker_kwargs=dict(
+                n_slots=args.n_slots,
+                max_total=eng_kwargs["max_total"],
+                queue_capacity=args.queue_capacity),
+            slo=slo, metrics_writer=writer)
+        print(f"fleet: spawned {topology} worker process(es), lanes at "
+              f"{lane_dir}", file=sys.stderr)
+        eng = None
+    elif args.disagg:
         from chainermn_tpu.serving import build_disagg_fleet
         disagg = build_disagg_fleet(
             trained, n_p, n_d, head_dim=head_dim,
@@ -242,8 +303,9 @@ def main(argv=None):
     else:
         eng = ServingEngine(trained, metrics_writer=writer, slo=slo,
                             **eng_kwargs)
-    service = disagg if disagg is not None else (
-        router if router is not None else eng)
+    service = fleet if fleet is not None else (
+        disagg if disagg is not None else (
+            router if router is not None else eng))
     statusz = None
     if args.statusz_port is not None:
         statusz = obs.start_status_server(
@@ -271,16 +333,34 @@ def main(argv=None):
                          "rng": jax.random.fold_in(base_key, i)}
                      for i in range(args.requests)}
 
+    # client-side honor of retry_after_ms (ISSUE 10 satellite): a shed/
+    # full rejection backs off (jittered, bounded) and retries before
+    # giving up machine-readably; while waiting the demo keeps DRIVING
+    # the service, so in-process topologies can actually drain the
+    # backlog the rejection named
+    from chainermn_tpu.serving.fleet import submit_with_retry
+
+    def driving_sleep(seconds):
+        deadline = time.monotonic() + seconds
+        while time.monotonic() < deadline:
+            service.step()
+
     def submit(i):
         try:
-            handles[i] = service.submit(prompts[i], args.max_new_tokens,
-                                        on_token=stream,
-                                        **sample_kw.get(i, {}))
+            handles[i] = submit_with_retry(
+                service.submit, prompts[i], args.max_new_tokens,
+                max_attempts=max(args.submit_retries, 1),
+                sleep=driving_sleep, on_token=stream,
+                **sample_kw.get(i, {}))
         except AdmissionError as e:
             rejected[i] = e.to_dict()
-            print(f"request {i} rejected: {e}", file=sys.stderr)
+            print(f"request {i} rejected after "
+                  f"{max(args.submit_retries, 1)} attempt(s): {e}",
+                  file=sys.stderr)
 
     def service_busy():
+        if fleet is not None:
+            return fleet.busy
         if disagg is not None:
             return (any(not w.idle for w in disagg.prefill_workers)
                     or any(not w.idle for w in disagg.decode_workers))
@@ -331,8 +411,21 @@ def main(argv=None):
         print(f"prompt {prompts[i].tolist()} -> {toks} "
               f"(true continuation {want[i].tolist()})", file=sys.stderr)
 
+    fleet_exit_codes = None
+    if fleet is not None:
+        # graceful ROLLING drain (the ISSUE 10 acceptance: in-flight
+        # work finishes, nothing sheds, every worker exits 0)
+        for name in list(fleet.workers):
+            if fleet.workers[name].state in ("starting", "live"):
+                fleet.drain(name)
+                fleet.wait_drained(name, timeout_s=60)
+        fleet_exit_codes = fleet.shutdown()
+        print(f"fleet: drained; worker exit codes {fleet_exit_codes}",
+              file=sys.stderr)
     metrics = service.metrics()
-    if disagg is not None:
+    if fleet is not None:
+        goodput = fleet.goodput.report()
+    elif disagg is not None:
         # per-worker wall-clock partitions: prefill ledgers carry the
         # transfer bucket, decode ledgers the tick compute/queue-wait
         # split (summing across workers double-counts wall)
@@ -363,6 +456,9 @@ def main(argv=None):
         "engine_steps": steps,
         "replicas": args.replicas,
         "disagg": args.disagg,
+        "fleet_procs": args.fleet_procs or (
+            sum(1 for _ in fleet.workers) if fleet is not None else 0),
+        "fleet_exit_codes": fleet_exit_codes,
         "requests": per_request,
         "mean_continuation_accuracy": (
             round(float(np.mean(correct)), 3) if correct else None),

@@ -55,9 +55,30 @@ prefill/decode"):
   :class:`DisaggRouter` (prompts → least-loaded prefill worker, slabs
   → decode worker by free slots + deadline feasibility).
 
+Cross-process layer (ISSUE 10; docs/ROBUSTNESS.md "Serving failure
+domains"):
+
+* :mod:`~chainermn_tpu.serving.lanes` — the elastic object-lane
+  transport (:class:`FileLaneStore` over a shared directory for
+  unrelated processes) plus single-writer mailboxes, every operation
+  under the hardened ``lane_call`` discipline.
+* :mod:`~chainermn_tpu.serving.health` — heartbeat leases, epoch
+  fencing (a zombie's stale writes are refused and counted), and the
+  per-worker circuit breaker governing re-admission.
+* :mod:`~chainermn_tpu.serving.worker` — the per-PROCESS role loops
+  (``engine`` / ``prefill`` / ``decode``) the fleet spawner execs;
+  drain finishes in-flight work and exits 0.
+* :mod:`~chainermn_tpu.serving.fleet` — :class:`FleetRouter`:
+  lease-driven dispatch, death detection within the configured window,
+  in-flight request failover (re-dispatch or machine-readable
+  ``worker_lost`` shed), ``drain(worker)`` rolling restart, and
+  :func:`submit_with_retry` (the client-side honor of
+  ``retry_after_ms``).
+
 ``python -m chainermn_tpu.serve`` is the CLI demo over the toy-corpus
 LM from ``examples/generate`` (``--replicas N`` stands up the fleet,
-``--disagg P:D`` the disaggregated topology).  See docs/SERVING.md.
+``--disagg P:D`` the disaggregated topology, ``--fleet-procs N`` the
+cross-process gang).  See docs/SERVING.md.
 """
 
 from .scheduler import (  # noqa: F401
@@ -73,7 +94,10 @@ __all__ = ["AdmissionError", "Request", "Scheduler", "SlotAllocator",
            "ServingEngine", "RequestHandle", "CachePool", "DecodeEngine",
            "Replica", "ServingRouter", "build_fleet",
            "KvTransferPlane", "DisaggRouter", "PrefillWorker",
-           "DecodeWorker", "build_disagg_fleet"]
+           "DecodeWorker", "build_disagg_fleet",
+           "FileLaneStore", "WorkerRuntime", "FleetRouter",
+           "WorkerClient", "build_proc_fleet", "build_local_fleet",
+           "submit_with_retry"]
 
 
 def __getattr__(name):
@@ -102,4 +126,14 @@ def __getattr__(name):
                 "build_disagg_fleet"):
         from . import disagg
         return getattr(disagg, name)
+    if name == "FileLaneStore":
+        from .lanes import FileLaneStore
+        return FileLaneStore
+    if name == "WorkerRuntime":
+        from .worker import WorkerRuntime
+        return WorkerRuntime
+    if name in ("FleetRouter", "WorkerClient", "build_proc_fleet",
+                "build_local_fleet", "submit_with_retry"):
+        from . import fleet
+        return getattr(fleet, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -112,9 +112,12 @@ class RequestHandle:
     @property
     def shed_payload(self) -> Optional[Dict[str, Any]]:
         """The machine-readable ``AdmissionError.to_dict()`` payload
-        when a disaggregated fleet shed this ALREADY-ACCEPTED request
-        (reason ``worker_lost`` — its prefill worker died mid-transfer
-        with no retry budget; ISSUE 9), else None."""
+        when a fleet shed this ALREADY-ACCEPTED request (reason
+        ``worker_lost``): its disagg prefill worker died mid-transfer
+        with no retry budget (ISSUE 9), or its cross-process worker
+        missed the lease window with no survivor / spent the failover
+        budget (ISSUE 10).  Carries ``retry_after_ms`` — clients honor
+        it with ``serving.fleet.submit_with_retry``.  None otherwise."""
         return getattr(self._req, "shed_payload", None)
 
     def wait(self, timeout: Optional[float] = None) -> bool:

@@ -1,0 +1,193 @@
+"""Cross-process object lanes + mailboxes for the serving fleet.
+
+ISSUE 10's worker processes speak to the router over the hardened
+object lanes (``communicators/base.py::lane_call`` retry + transient/
+permanent classification, faults NAMING the lane) — but the
+jax.distributed KV store that backs ``XlaCommunicator
+.kv_lane_transport()`` needs every process inside ONE fixed-size
+distributed runtime, which is exactly the wrong shape for an elastic
+serving fleet whose whole point is that members die, drain, and join
+independently.  :class:`FileLaneStore` is the elastic wire: the same
+``put(tag, bytes) / get(tag, timeout_s) / delete(tag)`` face over a
+shared directory, usable by UNRELATED processes (atomic tmp-then-rename
+publishes, so a reader sees a payload completely or not at all — the
+flight-bundle discipline applied to the wire).  A multi-controller
+deployment swaps the communicator-backed store in without touching the
+protocol above it.
+
+On top of any lane store, :class:`MailboxSender`/:class:`MailboxReceiver`
+make an ordered, at-most-once message channel: every mailbox has exactly
+ONE writer (the fleet wiring guarantees it: the router writes each
+worker's control inbox, each worker writes its own outbox), so a
+sender-side sequence counter + receiver-side cursor give total order
+without locks or collectives.  Messages are pickled dicts stamped with
+``MSG_SCHEMA`` — a receiver refuses a payload it cannot interpret,
+never guesses.  Every store operation goes through :func:`lane_call`,
+so retries/backoff/fault-injection ride the PR 8 discipline and a
+permanent fault raises :class:`~chainermn_tpu.communicators.base
+.DcnLaneError` naming ``worker_lane/<mailbox>/<op>``.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import tempfile
+import time
+from typing import Any, Dict, Optional
+
+#: Wire schema of one mailbox message (bump on layout change).
+MSG_SCHEMA = "chainermn_tpu.worker_lane.v1"
+
+
+def _safe_tag(tag: str) -> str:
+    """Filesystem-safe encoding of a lane tag (tags use '/' and '.')."""
+    return "".join(c if c.isalnum() or c in "-_." else f"_{ord(c):02x}"
+                   for c in str(tag))
+
+
+class FileLaneStore:
+    """Directory-backed object lane: the cross-process transport for
+    fleets of unrelated processes (no fixed-size gang, no coordinator).
+
+    ``put`` is atomic (tmp file + ``os.rename`` in one directory), so a
+    concurrent ``get`` never observes a torn payload.  ``get`` polls at
+    ``poll_s`` until the tag appears or ``timeout_s`` elapses — the
+    TimeoutError's text matches the lanes' TRANSIENT fingerprints
+    ("deadline exceeded"), so a ``lane_call``-wrapped get retries under
+    the standard backoff before dying loudly.
+    """
+
+    def __init__(self, root: str, poll_s: float = 0.005):
+        self.root = str(root)
+        self.poll_s = float(poll_s)
+        os.makedirs(self.root, exist_ok=True)
+
+    def _path(self, tag: str) -> str:
+        return os.path.join(self.root, _safe_tag(tag))
+
+    def put(self, tag: str, payload: bytes) -> None:
+        fd, tmp = tempfile.mkstemp(dir=self.root, prefix=".tmp-")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(bytes(payload))
+            os.replace(tmp, self._path(tag))
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+
+    def get(self, tag: str, timeout_s: float = 10.0) -> bytes:
+        deadline = time.monotonic() + float(timeout_s)
+        path = self._path(tag)
+        while True:
+            try:
+                with open(path, "rb") as f:
+                    return f.read()
+            except FileNotFoundError:
+                pass
+            if time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"lane tag {tag!r} not published within {timeout_s}s "
+                    f"(deadline exceeded)")
+            time.sleep(self.poll_s)
+
+    def delete(self, tag: str) -> None:
+        try:
+            os.unlink(self._path(tag))
+        except FileNotFoundError:
+            pass
+
+
+def lane_try_get(store, lane: str, tag: str,
+                 config=None) -> Optional[bytes]:
+    """Non-blocking lane read under the hardened discipline: the
+    payload, or None when the tag is simply absent (an empty mailbox is
+    not a fault).  Real store faults still classify/retry/raise through
+    :func:`~chainermn_tpu.communicators.base.lane_call` with the lane
+    named."""
+    from ..communicators.base import lane_call
+
+    def _try():
+        try:
+            return store.get(tag, timeout_s=0.0)
+        except (TimeoutError, KeyError):
+            return None
+
+    return lane_call(lane, _try, config)
+
+
+class MailboxSender:
+    """The single writer of one named mailbox (ordered, at-most-once).
+
+    ``seq`` persists only in this sender — the single-writer contract
+    makes it the mailbox's total order.  A re-created sender for a live
+    mailbox (e.g. a restarted router) must pass the old cursor via
+    ``start_seq`` or use a fresh mailbox name (a new worker epoch gets
+    a new mailbox in the fleet wiring, which is what fencing wants
+    anyway: a zombie's stale mailbox is simply never read again).
+    """
+
+    def __init__(self, store, name: str, config=None, start_seq: int = 0):
+        self.store = store
+        self.name = str(name)
+        self.config = config
+        self.seq = int(start_seq)
+
+    def send(self, msg: Dict[str, Any]) -> int:
+        """Publish one message; returns its sequence number."""
+        from ..communicators.base import lane_call
+
+        seq = self.seq
+        payload = pickle.dumps(
+            dict(msg, schema=MSG_SCHEMA, seq=seq),
+            protocol=pickle.HIGHEST_PROTOCOL)
+        tag = f"mbx/{self.name}/{seq}"
+        lane_call(f"worker_lane/{self.name}/send",
+                  lambda: self.store.put(tag, payload), self.config)
+        self.seq = seq + 1
+        return seq
+
+
+class MailboxReceiver:
+    """The single reader of one named mailbox: consumes messages in
+    sequence order, deleting each behind the cursor (at-most-once)."""
+
+    def __init__(self, store, name: str, config=None):
+        self.store = store
+        self.name = str(name)
+        self.config = config
+        self.next_seq = 0
+
+    def recv(self) -> Optional[Dict[str, Any]]:
+        """Next message, or None when the mailbox is empty."""
+        tag = f"mbx/{self.name}/{self.next_seq}"
+        payload = lane_try_get(self.store,
+                               f"worker_lane/{self.name}/recv", tag,
+                               self.config)
+        if payload is None:
+            return None
+        msg = pickle.loads(payload)
+        if msg.get("schema") != MSG_SCHEMA:
+            raise ValueError(
+                f"refusing worker-lane message with schema "
+                f"{msg.get('schema')!r} on mailbox {self.name!r} "
+                f"(this receiver speaks {MSG_SCHEMA})")
+        from ..communicators.base import lane_call
+        lane_call(f"worker_lane/{self.name}/gc",
+                  lambda: self.store.delete(tag), self.config)
+        self.next_seq += 1
+        return msg
+
+    def drain(self, limit: int = 256):
+        """Every pending message up to ``limit`` (bounded so a flooding
+        peer cannot wedge the caller's loop)."""
+        out = []
+        for _ in range(int(limit)):
+            msg = self.recv()
+            if msg is None:
+                break
+            out.append(msg)
+        return out

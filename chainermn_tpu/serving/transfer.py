@@ -332,38 +332,18 @@ class KvTransferPlane:
         lane_call(f"kv_transfer/gc/{tag}",
                   lambda: self.transport.delete(tag), self.lane_config)
 
-    def unpack_into(self, payload: bytes, dst_pool,
-                    dst_slot: int) -> Dict[str, Any]:
-        """Inject a packed slab into ``dst_slot`` (compiled pool-
-        lifetime slab write; the host pads the slab to the pool row so
-        the program needs no length operand) and book the RAW slab
-        bytes as a noted ``kv_transfer_lane@dcn`` ledger row — the
-        exact :func:`transfer_cost(mode="lanes")` prediction.  Returns
-        the wire dict's ``meta`` + transfer stats."""
+    def inject_program(self, dst_pool):
+        """The pool-lifetime compiled slab WRITE — the landing half of
+        every lane-mode transfer (and the ``serving.worker_lane``
+        analysis entry point's program): host-padded slab rows
+        ``dynamic_update_slice``\\ d into the destination slot, slot
+        index a traced operand so every landing after the first hits
+        the jit cache.  Zero collectives: each TP rank writes its local
+        KV columns."""
         import jax
-        import jax.numpy as jnp
         from jax.sharding import PartitionSpec as P
 
         from .._compat import shard_map
-
-        t0 = time.monotonic()
-        data = pickle.loads(payload)
-        if data.get("schema") != WIRE_SCHEMA:
-            raise ValueError(
-                f"refusing KV transfer with schema "
-                f"{data.get('schema')!r} (this receiver speaks "
-                f"{WIRE_SCHEMA})")
-        if data["n_layers"] != dst_pool.n_layers \
-                or data["kv_dim"] != dst_pool.kv_dim:
-            raise ValueError(
-                f"slab shape mismatch: wire (layers={data['n_layers']}, "
-                f"kv_dim={data['kv_dim']}) vs pool "
-                f"(layers={dst_pool.n_layers}, kv_dim={dst_pool.kv_dim})")
-        length = int(data["pos"])
-        if length > dst_pool.max_total:
-            raise ValueError(
-                f"slab length {length} exceeds destination per-slot "
-                f"capacity {dst_pool.max_total}")
 
         key = (dst_pool.n_layers, dst_pool.n_slots, dst_pool.max_total,
                dst_pool.kv_dim, str(dst_pool.caches[0][0].dtype),
@@ -395,6 +375,38 @@ class KvTransferPlane:
                 out_specs=dst_specs))
             from ..observability import flight as _flight
             _flight.note("compile", program="serving_kv_inject")
+        return prog
+
+    def unpack_into(self, payload: bytes, dst_pool,
+                    dst_slot: int) -> Dict[str, Any]:
+        """Inject a packed slab into ``dst_slot`` (compiled pool-
+        lifetime slab write; the host pads the slab to the pool row so
+        the program needs no length operand) and book the RAW slab
+        bytes as a noted ``kv_transfer_lane@dcn`` ledger row — the
+        exact :func:`transfer_cost(mode="lanes")` prediction.  Returns
+        the wire dict's ``meta`` + transfer stats."""
+        import jax.numpy as jnp
+
+        t0 = time.monotonic()
+        data = pickle.loads(payload)
+        if data.get("schema") != WIRE_SCHEMA:
+            raise ValueError(
+                f"refusing KV transfer with schema "
+                f"{data.get('schema')!r} (this receiver speaks "
+                f"{WIRE_SCHEMA})")
+        if data["n_layers"] != dst_pool.n_layers \
+                or data["kv_dim"] != dst_pool.kv_dim:
+            raise ValueError(
+                f"slab shape mismatch: wire (layers={data['n_layers']}, "
+                f"kv_dim={data['kv_dim']}) vs pool "
+                f"(layers={dst_pool.n_layers}, kv_dim={dst_pool.kv_dim})")
+        length = int(data["pos"])
+        if length > dst_pool.max_total:
+            raise ValueError(
+                f"slab length {length} exceeds destination per-slot "
+                f"capacity {dst_pool.max_total}")
+
+        prog = self.inject_program(dst_pool)
         # pad each layer's rows to the pool row (rows above ``length``
         # are stale-but-unreachable, the standard masking argument)
         slabs = []

@@ -69,11 +69,16 @@ _SKIP = re.compile(
 #: shed: the router's SLO-aware load shedding — a higher shed rate at
 #: the same offered load means less goodput; variance/requeue: the
 #: disagg bench's tick-gap spread and transfer-backpressure requeues —
-#: both rise when prefill interference leaks back in, ISSUE 9).
+#: both rise when prefill interference leaks back in, ISSUE 9;
+#: detection/failover/fenced/redispatch: the serving_chaos section's
+#: death-detection latency, failover TTFT penalty, zombie-fencing
+#: refusal and re-dispatch tallies — more of each means the fault
+#: story got slower or louder, ISSUE 10).
 _LOWER = re.compile(
     r"(time|_ms|ms_|/ms$|^ms$|latency|seconds|_s$|/s$|bytes|loss|"
     r"step_ms|gap|slowdown|imbalance|drift|anomal|dropped|findings|"
-    r"rejected|shed|steps_to_recover|variance|requeue)",
+    r"rejected|shed|steps_to_recover|variance|requeue|detection|"
+    r"failover|fenced|redispatch)",
     re.IGNORECASE)
 
 

@@ -125,6 +125,53 @@ def explain(bundle: dict) -> dict:
             out["goodput"] = {
                 "goodput_frac": train["goodput"].get("goodput_frac"),
                 "buckets_frac": train["goodput"].get("buckets_frac")}
+    # serving-fleet bundles (ISSUE 10): which worker, which lane, lease
+    # age at detection, and every in-flight request's failover outcome
+    extra = man.get("extra") or {}
+    wl = extra.get("worker_lost")
+    if isinstance(wl, dict):
+        inflight = wl.get("in_flight") or []
+        out["worker_lost"] = {
+            "worker": wl.get("worker"),
+            "role": wl.get("role"),
+            "lane": wl.get("lane"),
+            "why": wl.get("why"),
+            "lease_age_s": wl.get("lease_age_s"),
+            "detection_window_s": wl.get("detection_window_s"),
+            "epoch_fenced": wl.get("epoch_fenced"),
+            "in_flight": inflight,
+            "redispatched": sum(1 for r in inflight
+                                if r.get("outcome") == "redispatched"),
+            "shed": sum(1 for r in inflight
+                        if r.get("outcome") == "shed"),
+        }
+    drain = extra.get("drain")
+    if isinstance(drain, dict):
+        out["drain"] = {
+            "worker": drain.get("worker"),
+            "role": drain.get("role"),
+            "lane": drain.get("lane"),
+            "lease_age_s": drain.get("lease_age_s"),
+            "shed": len(drain.get("in_flight") or []),
+        }
+    if man.get("reason") == "kv_transfer_fault" or (
+            "worker" in extra and "lane" in extra):
+        out["kv_transfer_fault"] = {
+            "worker": extra.get("worker"),
+            "lane": extra.get("lane"),
+            "trace_id": extra.get("trace_id"),
+        }
+    fleet = providers.get("fleet_health")
+    if isinstance(fleet, dict):
+        out["fleet_at_death"] = {
+            "workers": {n: {"state": w.get("state"),
+                            "lease_age_s": w.get("lease_age_s"),
+                            "in_flight": w.get("in_flight")}
+                        for n, w in (fleet.get("workers") or {}).items()},
+            "fenced_refusals": fleet.get("fenced_refusals"),
+            "redispatched": fleet.get("redispatched"),
+            "shed_inflight": fleet.get("shed_inflight"),
+        }
     # preemption bundles (ISSUE 8): the scheduler took the node, not a
     # bug — surface the grace accounting and the elastic resume hint
     pre = (man.get("extra") or {}).get("preempt")
@@ -168,6 +215,38 @@ def render_text(rep: dict) -> str:
         lines.append(f"  serving: {json.dumps(rep['serving'])}")
         lines.append(f"  requests at death: "
                      f"{json.dumps(rep['requests_at_death'])}")
+    if rep.get("worker_lost"):
+        wl = rep["worker_lost"]
+        lines.append(
+            f"  worker lost: {wl.get('worker')} ({wl.get('role')}) on "
+            f"lane {wl.get('lane')}")
+        lines.append(
+            f"    lease age at detection: {wl.get('lease_age_s')}s "
+            f"(window {wl.get('detection_window_s')}s, epoch "
+            f"{wl.get('epoch_fenced')} fenced)")
+        lines.append(
+            f"    in-flight: {wl.get('redispatched')} re-dispatched, "
+            f"{wl.get('shed')} shed")
+        for row in wl.get("in_flight", []):
+            lines.append(
+                f"      {row.get('trace_id')}: {row.get('outcome')}"
+                + (f" -> {row['to']}" if row.get("to") else ""))
+    if rep.get("drain"):
+        dr = rep["drain"]
+        lines.append(
+            f"  drain: {dr.get('worker')} ({dr.get('role')}) finished "
+            f"in-flight work and exited (shed {dr.get('shed')})")
+    if rep.get("kv_transfer_fault"):
+        kv = rep["kv_transfer_fault"]
+        lines.append(
+            f"  kv transfer fault: worker {kv.get('worker')} on lane "
+            f"{kv.get('lane')} (trace {kv.get('trace_id')})")
+    if rep.get("fleet_at_death"):
+        fl = rep["fleet_at_death"]
+        lines.append(f"  fleet at death: {json.dumps(fl['workers'])}")
+        if fl.get("fenced_refusals"):
+            lines.append(
+                f"    fenced refusals: {json.dumps(fl['fenced_refusals'])}")
     if rep.get("preempt"):
         pre = rep["preempt"]
         used = pre.get("grace_used_s")

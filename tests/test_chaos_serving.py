@@ -1,0 +1,286 @@
+"""Serving-fleet chaos acceptance against REAL worker processes
+(ISSUE 10, slow tier; docs/ROBUSTNESS.md "Serving failure domains").
+
+One gang, three phases (spawning a worker process costs a jax boot, so
+the phases share it):
+
+* **SIGKILL** a worker mid-decode under live load: the router detects
+  death within the configured lease window, every in-flight request
+  either completes TOKEN-EXACT on a survivor (greedy decoding is
+  deterministic — the failover result matches an uninterrupted run) or
+  is shed with a machine-readable ``worker_lost`` + ``retry_after_ms``,
+  no thread or gang member hangs (every wait is deadline-bounded), and
+  a flight bundle names the dead worker and lane.
+* **SIGSTOP/SIGCONT** makes a real zombie: while paused it misses the
+  lease window and is fenced; resumed, its stale-epoch leases are
+  REFUSED AND COUNTED; the circuit breaker then re-admits it under a
+  fresh epoch and it serves again.
+* **Graceful drain**: ``drain(worker)`` finishes in-flight work, sheds
+  nothing, and the worker process EXITS 0.
+
+Plus the ``serve --fleet-procs`` CLI smoke (schema-checked summary,
+rolling drain, per-worker exit code 0).
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+ROOT = os.path.join(os.path.dirname(__file__), "..")
+
+VOCAB, D, HEADS, LAYERS = 32, 16, 4, 2
+HEAD_DIM = D // HEADS
+
+
+def _worker_env():
+    # workers get ONE cpu device (the parent test process forces 8
+    # virtual devices; an inherited flag would build a TP=8 engine)
+    flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
+             if "host_platform_device_count" not in f]
+    return {"XLA_FLAGS": " ".join(flags), "JAX_PLATFORMS": "cpu",
+            "PYTHONPATH": os.path.abspath(ROOT)}
+
+
+def _oracle_fn(params, devices, max_new):
+    import chainermn_tpu as mn
+    from chainermn_tpu.parallel import make_lm_generator
+
+    mesh = mn.make_nd_mesh(("model",), (1,), devices[:1])
+    gen = make_lm_generator(mesh, "model", head_dim=HEAD_DIM,
+                            max_new_tokens=max_new)
+    return lambda p: np.asarray(gen(params, np.asarray(p)[None]))[0].tolist()
+
+
+def _pump_until(router, pred, timeout, what):
+    t0 = time.time()
+    while not pred():
+        assert time.time() - t0 < timeout, f"hang waiting for {what}"
+        router.step()
+        time.sleep(0.01)
+
+
+@pytest.mark.slow
+def test_sigkill_zombie_and_drain_against_real_processes(devices,
+                                                         tmp_path):
+    import jax
+
+    from chainermn_tpu.parallel import init_tp_transformer_lm
+    from chainermn_tpu.serving.fleet import build_proc_fleet
+
+    params = init_tp_transformer_lm(
+        jax.random.PRNGKey(0), VOCAB, D, HEADS, LAYERS, max_len=64,
+        pos_impl="rope")
+    bundles = str(tmp_path / "bundles")
+    router = build_proc_fleet(
+        params, {"engine": 3}, str(tmp_path / "lanes"),
+        head_dim=HEAD_DIM, beat_interval_s=0.05, miss_beats=4,
+        bundle_dir=bundles, env=_worker_env(),
+        worker_kwargs=dict(n_slots=2, max_total=24, queue_capacity=16))
+    oracle = _oracle_fn(params, devices, 8)
+    try:
+        _pump_until(router,
+                    lambda: all(w.state == "live"
+                                for w in router.workers.values()),
+                    timeout=120, what="worker boot leases")
+
+        # ---- phase 1: SIGKILL engine0 mid-decode under live load ----
+        rng = np.random.RandomState(0)
+        prompts = [rng.randint(0, VOCAB, 5).astype(np.int32)
+                   for _ in range(8)]
+        handles = [router.submit(p, 8) for p in prompts]
+        victim = router.workers["engine0"]
+        # wait until the victim actually carries in-flight work and
+        # has streamed at least one token (mid-decode, not mid-queue)
+        _pump_until(
+            router,
+            lambda: any(e["worker"] == "engine0" and e["req"].tokens
+                        for e in router._inflight.values()),
+            timeout=60, what="in-flight decode on the victim")
+        t_kill = time.monotonic()
+        os.kill(victim.proc.pid, signal.SIGKILL)
+        _pump_until(router,
+                    lambda: all(h.status in ("done", "evicted")
+                                for h in handles),
+                    timeout=120, what="failover to survivors")
+        detect_s = time.monotonic() - t_kill
+        det = router.last_detection
+        assert det is not None and det["worker"] == "engine0"
+        assert "out.engine0" in det["lane"]
+        # detection within the window (+ generous pump-loop slack)
+        assert detect_s < router.lease_window_s + 2.0, detect_s
+        done = shed = 0
+        for p, h in zip(prompts, handles):
+            if h.status == "done":
+                done += 1
+                assert h.shed_payload is None
+                assert h.tokens == oracle(p), (h.tokens, oracle(p))
+            else:
+                shed += 1
+                pay = h.shed_payload
+                assert pay is not None
+                assert pay["reason"] == "worker_lost"
+                assert pay["retry_after_ms"] >= 1.0
+        assert done + shed == len(handles)
+        assert done > 0          # survivors actually picked up work
+        # the bundle names the dead worker + lane; explain renders it
+        from chainermn_tpu.observability.flight import find_bundles
+        wl_bundles = [b for b in find_bundles(bundles)
+                      if "worker_lost" in os.path.basename(b)]
+        assert wl_bundles
+        out = subprocess.run(
+            [sys.executable,
+             os.path.join(ROOT, "scripts", "explain_bundle.py"),
+             wl_bundles[-1], "--json"],
+            capture_output=True, text=True, timeout=60)
+        assert out.returncode == 0, out.stderr
+        rep = json.loads(out.stdout)
+        assert rep["worker_lost"]["worker"] == "engine0"
+        assert "out.engine0" in rep["worker_lost"]["lane"]
+        assert rep["worker_lost"]["lease_age_s"] is not None
+        for row in rep["worker_lost"]["in_flight"]:
+            assert row["outcome"] in ("redispatched", "shed")
+
+        # ---- phase 2: SIGSTOP/SIGCONT -> a real zombie ----
+        zombie = router.workers["engine1"]
+        os.kill(zombie.proc.pid, signal.SIGSTOP)
+        try:
+            _pump_until(router, lambda: zombie.state == "dead",
+                        timeout=60, what="zombie lease-window death")
+        finally:
+            os.kill(zombie.proc.pid, signal.SIGCONT)
+        old_epoch = zombie.epoch
+        baseline = dict(router.fence.refusal_counts())
+        # resumed: its stale-epoch leases must be refused and counted
+        _pump_until(
+            router,
+            lambda: router.fence.refusal_counts().get("lease", 0)
+            > baseline.get("lease", 0),
+            timeout=60, what="fenced zombie lease refusals")
+        # breaker-governed re-admission under a FRESH epoch
+        _pump_until(router,
+                    lambda: zombie.state == "live"
+                    and zombie.epoch > old_epoch,
+                    timeout=60, what="breaker re-admission")
+        h = router.submit(prompts[0], 6)
+        _pump_until(router, lambda: h.status in ("done", "evicted"),
+                    timeout=120, what="post-readmission request")
+        assert h.status == "done"
+
+        # ---- phase 3: graceful drain -> worker exits 0 ----
+        pre = router.metrics()
+        target = "engine2" if router.workers["engine2"].state == "live" \
+            else "engine1"
+        hs = [router.submit(p, 6) for p in prompts[:2]]
+        router.drain(target)
+        assert router.wait_drained(target, timeout_s=120), \
+            "drain hung"
+        _pump_until(router,
+                    lambda: all(h.status in ("done", "evicted")
+                                for h in hs),
+                    timeout=120, what="drain-overlapped requests")
+        assert all(h.status == "done" for h in hs), \
+            [(h.status, h.finish_reason) for h in hs]
+        post = router.metrics()
+        assert post["fleet/shed_inflight_total"] == \
+            pre["fleet/shed_inflight_total"]      # drain sheds NOTHING
+        rc = router.workers[target].proc.wait(timeout=60)
+        assert rc == 0, f"drained worker exited {rc}, want 0"
+    finally:
+        codes = router.shutdown(timeout_s=60)
+        router.close()
+    # every surviving member terminated (no gang member hangs)
+    for name, wc in router.workers.items():
+        if wc.proc is not None:
+            assert wc.proc.poll() is not None, f"{name} still running"
+
+
+@pytest.mark.slow
+def test_serving_chaos_bench_section_and_gate(tmp_path):
+    """The ``serving_chaos`` bench section (ISSUE 10 satellite): runs
+    on this backend, carries the detection/failover/shed/recovery
+    keys, meets the drain acceptance (sheds nothing, tok/s recovers to
+    within 10% of pre-drain steady state), and is ACCEPTED by
+    check_perf_regression.py with the right key directions."""
+    sys.path.insert(0, ROOT)
+    try:
+        import bench
+        section = bench.bench_serving_chaos()
+    finally:
+        sys.path.remove(ROOT)
+
+    for key in ("steady_tokens_per_sec", "detection_ms",
+                "detection_window_ms", "failover_ttft_p99_ms",
+                "redispatched", "kill_shed_rate", "kill_terminal_frac",
+                "kill_recovery_s", "drain_completed", "drain_shed",
+                "post_drain_tokens_per_sec", "drain_recovery_frac",
+                "fenced_refusals"):
+        assert key in section, (key, section)
+    # chaos acceptance: detection within the window (+ slack for the
+    # supervisor poll cadence), every request terminal, and the
+    # graceful-drain bound
+    assert section["detection_ms"] <= section["detection_window_ms"] \
+        + 500.0, section
+    assert section["kill_terminal_frac"] == 1.0, section
+    assert section["drain_completed"] is True
+    assert section["drain_shed"] == 0, section
+    assert section["drain_recovery_frac"] >= 0.9, section
+
+    path = tmp_path / "chaos.json"
+    path.write_text(json.dumps({"serving_chaos": section}))
+    gate = subprocess.run(
+        [sys.executable,
+         os.path.join(ROOT, "scripts", "check_perf_regression.py"),
+         str(path), str(path), "--json"],
+        capture_output=True, text=True, timeout=120)
+    assert gate.returncode == 0, (gate.stdout, gate.stderr)
+    verdict = json.loads(gate.stdout)
+    # zero-valued keys (a clean run's shed/fenced tallies) are skipped
+    # by the relative-diff gate — the non-zero core must still compare
+    assert verdict["ok"] and verdict["compared"] >= 7, verdict
+
+    sys.path.insert(0, ROOT)
+    try:
+        from scripts.check_perf_regression import lower_is_better
+    finally:
+        sys.path.remove(ROOT)
+    for key in ("serving_chaos/detection_ms",
+                "serving_chaos/failover_ttft_p99_ms",
+                "serving_chaos/kill_shed_rate",
+                "serving_chaos/kill_recovery_s",
+                "serving_chaos/drain_shed",
+                "serving_chaos/fenced_refusals",
+                "serving_chaos/redispatched"):
+        assert lower_is_better(key), key
+    assert not lower_is_better("serving_chaos/drain_recovery_frac")
+    assert not lower_is_better("serving_chaos/steady_tokens_per_sec")
+
+
+@pytest.mark.slow
+def test_serve_cli_fleet_procs_subprocess(tmp_path):
+    """`serve --fleet-procs 2` end to end in a fresh interpreter:
+    schema-checked summary, every request terminal, rolling drain with
+    per-worker exit code 0, submit_with_retry wired into the demo."""
+    env = dict(os.environ, **_worker_env())
+    out = subprocess.run(
+        [sys.executable, "-m", "chainermn_tpu.serve",
+         "--fleet-procs", "2", "--requests", "6", "--train-steps", "30",
+         "--prompt-len", "5", "--max-new-tokens", "6",
+         "--lane-dir", str(tmp_path / "lanes")],
+        capture_output=True, text=True, timeout=420, env=env,
+        cwd=ROOT)
+    assert out.returncode == 0, out.stderr[-3000:]
+    summary = json.loads(out.stdout.strip().splitlines()[-1])
+    assert summary["schema"] == "chainermn_tpu.serve.v1"
+    assert summary["fleet_procs"] == 2
+    assert summary["fleet_exit_codes"] == {"engine0": 0, "engine1": 0}
+    statuses = {r["status"] for r in summary["requests"]}
+    assert statuses <= {"done", "rejected"}
+    assert sum(r["status"] == "done" for r in summary["requests"]) >= 4
+    assert summary["metrics"]["fleet/shed_rate"] == 0.0
+    assert summary["goodput"]["buckets_s"]["supervise"] >= 0.0

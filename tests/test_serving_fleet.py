@@ -1,0 +1,477 @@
+"""Cross-process serving fleet tests (ISSUE 10), fast tier.
+
+Four layers, cheapest first:
+
+* **Lane/mailbox units** (jax-free): the file lane store's atomic
+  put/get/delete + timeout classification, single-writer mailbox
+  ordering + at-most-once delivery + schema refusal.
+* **Health-plane units** (jax-free): lease detection-window math,
+  epoch fencing (stale writes refused AND counted), circuit-breaker
+  backoff/budget, and the ``submit_with_retry`` backoff schedule
+  (honors ``retry_after_ms``, jittered, bounded, gives up
+  machine-readably).
+* **In-process fleet** (devices): the REAL worker/router protocol over
+  the loopback store — end-to-end token-exactness vs ``lm_generate``,
+  kill → detection within the lease window → failover (re-dispatch
+  token-exact, or machine-readable ``worker_lost`` shed; every
+  in-flight request exactly ONE outcome), zombie fencing (resumed
+  worker's stale-epoch leases/tokens/results refused and counted),
+  breaker-governed re-admission, graceful drain (sheds nothing,
+  finishes in-flight, terminates the loop), and the disagg role-split
+  topology over the same plane.
+* **Bundle rendering**: ``worker_lost``/``drain`` bundles carry the
+  worker, lane, lease age, and per-request failover outcomes, and
+  ``scripts/explain_bundle.py`` renders them.
+
+The SIGKILL/SIGSTOP acceptance against real worker PROCESSES lives in
+tests/test_chaos_serving.py (slow tier).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from chainermn_tpu.serving import AdmissionError
+from chainermn_tpu.serving.health import (CircuitBreaker, EpochFence,
+                                          detection_window_s)
+from chainermn_tpu.serving.lanes import (MSG_SCHEMA, FileLaneStore,
+                                         MailboxReceiver, MailboxSender)
+
+ROOT = os.path.join(os.path.dirname(__file__), "..")
+
+VOCAB, D, HEADS, LAYERS = 32, 16, 4, 2
+HEAD_DIM = D // HEADS
+
+
+# ---------------------------------------------------------------------------
+# lane / mailbox units (no jax)
+# ---------------------------------------------------------------------------
+
+def test_file_lane_store_roundtrip(tmp_path):
+    store = FileLaneStore(str(tmp_path / "lanes"))
+    store.put("slab/req-1.slab", b"payload")
+    assert store.get("slab/req-1.slab", timeout_s=0.0) == b"payload"
+    store.put("slab/req-1.slab", b"v2")          # overwrite is atomic
+    assert store.get("slab/req-1.slab", timeout_s=0.0) == b"v2"
+    store.delete("slab/req-1.slab")
+    store.delete("slab/req-1.slab")              # idempotent
+    with pytest.raises(TimeoutError, match="deadline exceeded"):
+        store.get("slab/req-1.slab", timeout_s=0.05)
+    # hostile tag characters never escape the root directory
+    store.put("../../etc/passwd", b"x")
+    names = os.listdir(str(tmp_path / "lanes"))
+    assert all("/" not in n for n in names)
+    assert store.get("../../etc/passwd", timeout_s=0.0) == b"x"
+
+
+def test_mailbox_order_and_at_most_once(tmp_path):
+    store = FileLaneStore(str(tmp_path))
+    tx = MailboxSender(store, "ctl.w0")
+    rx = MailboxReceiver(store, "ctl.w0")
+    assert rx.recv() is None                     # empty != fault
+    for i in range(5):
+        tx.send({"kind": "submit", "i": i})
+    got = rx.drain()
+    assert [m["i"] for m in got] == [0, 1, 2, 3, 4]   # total order
+    assert all(m["schema"] == MSG_SCHEMA for m in got)
+    assert rx.recv() is None                     # consumed exactly once
+    tx.send({"kind": "drain"})
+    assert rx.recv()["kind"] == "drain"          # cursor survives
+
+
+def test_mailbox_refuses_foreign_schema(tmp_path):
+    import pickle
+
+    store = FileLaneStore(str(tmp_path))
+    rx = MailboxReceiver(store, "ctl.w0")
+    store.put("mbx/ctl.w0/0", pickle.dumps({"schema": "bogus.v9",
+                                            "kind": "submit"}))
+    with pytest.raises(ValueError, match="refusing worker-lane message"):
+        rx.recv()
+
+
+# ---------------------------------------------------------------------------
+# health-plane units (no jax)
+# ---------------------------------------------------------------------------
+
+def test_detection_window_math():
+    # miss_beats missed beats + one interval of phase offset
+    assert detection_window_s(0.05, 4) == pytest.approx(0.25)
+    assert detection_window_s(0.02, 3) == pytest.approx(0.08)
+
+
+def test_epoch_fence_refuses_and_counts():
+    fence = EpochFence()
+    e1 = fence.new_epoch("w0")
+    assert fence.admit("w0", e1, "token")
+    assert not fence.admit("w0", e1 - 1, "token")     # stale epoch
+    fence.fence("w0")
+    assert not fence.admit("w0", e1, "lease")         # fenced current
+    assert not fence.admit("w0", e1, "slab_ready")
+    e2 = fence.new_epoch("w0")                        # re-admission
+    assert e2 > e1
+    assert fence.admit("w0", e2, "token")
+    assert not fence.admit("w0", e1, "result")        # zombie stamp
+    counts = fence.refusal_counts()
+    assert counts == {"token": 1, "lease": 1, "slab_ready": 1,
+                      "result": 1}
+    assert not fence.admit("unknown", 1, "lease")     # never admitted
+
+
+def test_circuit_breaker_backoff_and_budget():
+    clock = [0.0]
+    br = CircuitBreaker(max_failures=4, backoff_base_s=0.5,
+                        backoff_max_s=4.0, clock=lambda: clock[0])
+    assert br.allow()
+    br.record_failure()                  # hold-off 0.5
+    assert not br.allow()
+    clock[0] = 0.6
+    assert br.allow()                    # half-open after the hold-off
+    br.record_failure()                  # 2nd consecutive: 1.0
+    assert not br.allow()
+    clock[0] = 0.6 + 0.9
+    assert not br.allow()
+    clock[0] = 0.6 + 1.1
+    assert br.allow()
+    br.record_success()                  # closes + refunds the budget
+    assert br.failures == 0 and br.allow()
+    for _ in range(4):
+        br.record_failure()
+    assert br.permanently_open           # budget spent: removed forever
+    clock[0] = 1e9
+    assert not br.allow()
+
+
+def test_submit_with_retry_backoff_schedule():
+    """The satellite: bounded retries, jittered backoff that honors
+    retry_after_ms, machine-readable give-up."""
+    import random
+
+    from chainermn_tpu.serving.fleet import submit_with_retry
+
+    calls, delays = [], []
+
+    def submit(x, kw=None):
+        calls.append(x)
+        raise AdmissionError("shed_slo", "busy", retry_after_ms=40.0,
+                             queue_depth=3)
+
+    with pytest.raises(AdmissionError) as e:
+        submit_with_retry(submit, 7, max_attempts=4,
+                          base_backoff_ms=5.0, jitter_frac=0.25,
+                          jitter_rng=random.Random(0),
+                          sleep=lambda s: delays.append(s * 1e3))
+    # gave up machine-readably: the LAST rejection's payload intact
+    assert e.value.reason == "shed_slo"
+    assert e.value.to_dict()["retry_after_ms"] == 40.0
+    assert len(calls) == 4 and len(delays) == 3
+    # every delay honors retry_after_ms (=40 > the exponential base)
+    # within the ±25% jitter band
+    for d in delays:
+        assert 40.0 * 0.75 <= d <= 40.0 * 1.25, delays
+    # without retry_after_ms the exponential schedule takes over
+    delays.clear()
+    calls.clear()
+
+    def submit_plain(x):
+        calls.append(x)
+        raise AdmissionError("queue_full", "full")
+
+    with pytest.raises(AdmissionError):
+        submit_with_retry(submit_plain, 1, max_attempts=4,
+                          base_backoff_ms=8.0, jitter_frac=0.0,
+                          jitter_rng=random.Random(0),
+                          sleep=lambda s: delays.append(s * 1e3))
+    assert delays == [8.0, 16.0, 32.0]   # 2^k doubling, no jitter
+    # success on attempt 2 returns the handle and stops retrying
+    state = {"n": 0}
+
+    def flaky(x):
+        state["n"] += 1
+        if state["n"] == 1:
+            raise AdmissionError("queue_full", "full",
+                                 retry_after_ms=1.0)
+        return "handle"
+
+    assert submit_with_retry(flaky, 1, max_attempts=3,
+                             sleep=lambda s: None) == "handle"
+    assert state["n"] == 2
+
+
+# ---------------------------------------------------------------------------
+# in-process fleet (devices): the real protocol over the loopback store
+# ---------------------------------------------------------------------------
+
+def _params(seed=0):
+    import jax
+    from chainermn_tpu.parallel import init_tp_transformer_lm
+
+    return init_tp_transformer_lm(
+        jax.random.PRNGKey(seed), VOCAB, D, HEADS, LAYERS, max_len=64,
+        pos_impl="rope")
+
+
+def _mesh(devices):
+    import chainermn_tpu as mn
+
+    return mn.make_nd_mesh(("model",), (1,), devices[:1])
+
+
+def _oracle(params, mesh, prompt, max_new):
+    from chainermn_tpu.parallel import make_lm_generator
+
+    gen = make_lm_generator(mesh, "model", head_dim=HEAD_DIM,
+                            max_new_tokens=max_new)
+    return np.asarray(gen(params, np.asarray(prompt)[None]))[0].tolist()
+
+
+@pytest.fixture
+def local_fleet(devices, tmp_path):
+    from chainermn_tpu.serving.fleet import build_local_fleet
+
+    params = _params()
+    mesh = _mesh(devices)
+    router, runtimes = build_local_fleet(
+        params, {"engine": 2}, head_dim=HEAD_DIM,
+        bundle_dir=str(tmp_path / "bundles"),
+        beat_interval_s=0.01, miss_beats=3,
+        worker_kwargs=dict(n_slots=2, max_total=24, mesh=mesh))
+    yield params, mesh, router, runtimes, str(tmp_path / "bundles")
+    for rt in runtimes:
+        rt.finished = True
+    router.close()
+
+
+def _drive(router, runtimes, n=1, live=None):
+    for _ in range(n):
+        for rt in (live if live is not None else runtimes):
+            rt.step()
+        router.step()
+
+
+def _drive_until_terminal(router, runtimes, handles, live=None,
+                          timeout=90):
+    t0 = time.time()
+    while any(h.status not in ("done", "evicted") for h in handles):
+        assert time.time() - t0 < timeout, (
+            "fleet hung: " + str([(h.status, h.finish_reason)
+                                  for h in handles]))
+        _drive(router, runtimes, live=live)
+        time.sleep(0.001)
+
+
+def test_fleet_end_to_end_token_exact(local_fleet):
+    params, mesh, router, runtimes, _ = local_fleet
+    _drive(router, runtimes, n=3)
+    assert all(w.state == "live" for w in router.workers.values())
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(0, VOCAB, 5).astype(np.int32)
+               for _ in range(4)]
+    streamed = {}
+    handles = [
+        router.submit(p, 6, on_token=lambda t, rid, i=i:
+                      streamed.setdefault(i, []).append(t))
+        for i, p in enumerate(prompts)]
+    _drive_until_terminal(router, runtimes, handles)
+    for i, (p, h) in enumerate(zip(prompts, handles)):
+        want = _oracle(params, mesh, p, 6)
+        assert h.status == "done" and h.tokens == want, (
+            h.status, h.tokens, want)
+        assert streamed[i] == want        # streaming matched the result
+        assert h.ttft_ms is not None and h.ttft_ms > 0
+    # both workers took a share (least-loaded spread)
+    m = router.metrics()
+    assert m["fleet/dispatched_total"] == 4
+    assert m["fleet/shed_rate"] == 0
+
+
+def test_kill_failover_exactly_one_outcome(local_fleet):
+    """The chaos acceptance, in-process: kill a worker mid-decode under
+    live load — detection within the lease window, every in-flight
+    request either completes TOKEN-EXACT on the survivor or is shed
+    with a machine-readable worker_lost payload (never both), and the
+    bundle names the worker, the lane, and every outcome."""
+    from chainermn_tpu.observability.flight import find_bundles, read_bundle
+
+    params, mesh, router, runtimes, bundles = local_fleet
+    w0, w1 = runtimes
+    _drive(router, runtimes, n=3)
+    rng = np.random.RandomState(1)
+    prompts = [rng.randint(0, VOCAB, 5).astype(np.int32)
+               for _ in range(6)]
+    handles = [router.submit(p, 8) for p in prompts]
+    _drive(router, runtimes, n=2)         # work lands on both workers
+    t_kill = time.monotonic()
+    w0.kill()                             # heartbeats stop dead
+    _drive_until_terminal(router, runtimes, handles, live=[w1])
+    det = router.last_detection
+    assert det is not None and det["worker"] == "engine0"
+    assert "out.engine0" in det["lane"]
+    # detection within the configured window (+ drive-loop slack)
+    assert det["lease_age_s"] <= router.lease_window_s + 0.5
+    assert time.monotonic() - t_kill >= router.lease_window_s * 0.9
+    # every request exactly ONE terminal outcome
+    for p, h in zip(prompts, handles):
+        if h.status == "done":
+            assert h.shed_payload is None
+            assert h.tokens == _oracle(params, mesh, p, 8)
+        else:
+            pay = h.shed_payload
+            assert h.finish_reason == "shed" and pay is not None
+            assert pay["reason"] == "worker_lost"
+            assert pay["retry_after_ms"] >= 1.0
+            assert h.tokens == []          # a shed is never half-served
+    # the bundle names the worker, the lane, and each outcome once
+    paths = find_bundles(bundles)
+    assert paths, "no worker_lost bundle dumped"
+    wl = (read_bundle(paths[-1])["manifest"]["extra"] or {})["worker_lost"]
+    assert wl["worker"] == "engine0" and "out.engine0" in wl["lane"]
+    assert wl["lease_age_s"] is not None
+    traced = [r["trace_id"] for r in wl["in_flight"]]
+    assert len(traced) == len(set(traced))
+    assert all(r["outcome"] in ("redispatched", "shed")
+               for r in wl["in_flight"])
+    # explain_bundle renders it (the satellite)
+    out = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "scripts",
+                                      "explain_bundle.py"),
+         paths[-1], "--json"],
+        capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stderr
+    rep = json.loads(out.stdout)
+    assert rep["worker_lost"]["worker"] == "engine0"
+    assert "out.engine0" in rep["worker_lost"]["lane"]
+    assert rep["worker_lost"]["redispatched"] \
+        + rep["worker_lost"]["shed"] == len(traced)
+
+
+def test_zombie_fencing_and_breaker_readmission(local_fleet):
+    """The zombie acceptance: a paused-then-resumed worker with a stale
+    epoch cannot land slabs, tokens, or leases — refused and counted —
+    and re-admission is breaker-governed with a FRESH epoch."""
+    params, mesh, router, runtimes, _ = local_fleet
+    w0, w1 = runtimes
+    _drive(router, runtimes, n=3)
+    rng = np.random.RandomState(2)
+    prompts = [rng.randint(0, VOCAB, 5).astype(np.int32)
+               for _ in range(4)]
+    handles = [router.submit(p, 8) for p in prompts]
+    _drive(router, runtimes, n=2)
+    w0.kill()                              # pause (SIGSTOP's signature)
+    _drive_until_terminal(router, runtimes, handles, live=[w1])
+    assert router.workers["engine0"].state == "dead"
+    old_epoch = w0.epoch
+    # a CORPSE must STAY dead: its last lease file persists in the
+    # store, but a non-refreshing seq is not evidence of life — the
+    # breaker must never re-admit it, and re-judging the same stale
+    # payload must not inflate the refusal counters with wall time
+    time.sleep(0.6)                        # past the breaker hold-off
+    corpse_baseline = dict(router.fence.refusal_counts())
+    for _ in range(20):
+        _drive(router, runtimes, live=[w1])
+        time.sleep(0.002)
+    assert router.workers["engine0"].state == "dead"
+    assert router._readmitted == 0
+    assert router.fence.refusal_counts().get("lease", 0) \
+        <= corpse_baseline.get("lease", 0) + 1
+    baseline = dict(router.fence.refusal_counts())
+    w0.killed = False                      # resume: a real zombie now
+    for _ in range(10):
+        _drive(router, runtimes)
+        time.sleep(0.002)
+    counts = router.fence.refusal_counts()
+    assert counts.get("lease", 0) > baseline.get("lease", 0), counts
+    # its in-flight work finished while paused: stale tokens/results
+    # arrived under the old epoch and were refused
+    assert counts.get("token", 0) >= baseline.get("token", 0)
+    # nothing the zombie produced landed on any handle
+    for p, h in zip(prompts, handles):
+        if h.status == "done":
+            assert h.tokens == _oracle(params, mesh, p, 8)
+    # breaker re-admission: hold-off elapses -> hello with a NEW epoch
+    time.sleep(0.6)
+    for _ in range(10):
+        _drive(router, runtimes)
+        time.sleep(0.002)
+    wc = router.workers["engine0"]
+    assert wc.state == "live" and wc.epoch > old_epoch
+    assert w0.epoch == wc.epoch            # the hello was adopted
+    h = router.submit(prompts[0], 6)
+    _drive_until_terminal(router, runtimes, [h])
+    assert h.status == "done"
+    assert h.tokens == _oracle(params, mesh, prompts[0], 6)
+
+
+def test_graceful_drain_sheds_nothing(local_fleet):
+    """Drain acceptance (in-process half): in-flight requests finish,
+    nothing sheds, the lease is released, the loop terminates (the
+    process-exit-0 half lives in test_chaos_serving.py)."""
+    params, mesh, router, runtimes, bundles = local_fleet
+    w0, w1 = runtimes
+    _drive(router, runtimes, n=3)
+    rng = np.random.RandomState(3)
+    prompts = [rng.randint(0, VOCAB, 5).astype(np.int32)
+               for _ in range(4)]
+    handles = [router.submit(p, 8) for p in prompts]
+    _drive(router, runtimes, n=2)          # in-flight on both workers
+    router.drain("engine0")
+    t0 = time.time()
+    while router.workers["engine0"].state != "drained":
+        assert time.time() - t0 < 60, "drain hung"
+        _drive(router, runtimes)
+    assert w0.finished                     # the run() loop terminates
+    _drive_until_terminal(router, runtimes, handles, live=[w1])
+    # every request finished normally — a drain sheds NOTHING
+    for p, h in zip(prompts, handles):
+        assert h.status == "done", (h.status, h.finish_reason)
+        assert h.tokens == _oracle(params, mesh, p, 8)
+    m = router.metrics()
+    assert m["fleet/shed_inflight_total"] == 0
+    assert m["fleet/rejected_total"] == 0
+    assert m["fleet/drained_workers"] == 1
+    # new work flows to the survivor only
+    h = router.submit(prompts[0], 6)
+    _drive_until_terminal(router, runtimes, [h], live=[w1])
+    assert h.status == "done"
+    from chainermn_tpu.observability.flight import find_bundles
+    assert any("drain" in os.path.basename(p)
+               for p in find_bundles(bundles))
+
+
+def test_disagg_roles_over_the_lane_plane(devices):
+    """The role-split topology on the same plane: prompts -> prefill
+    worker -> slab over the lane -> install on a decode worker ->
+    streamed tokens, token-exact, pools drained."""
+    from chainermn_tpu.serving.fleet import build_local_fleet
+
+    params = _params()
+    mesh = _mesh(devices)
+    router, runtimes = build_local_fleet(
+        params, {"prefill": 1, "decode": 2}, head_dim=HEAD_DIM,
+        worker_kwargs=dict(n_slots=2, max_total=24, mesh=mesh))
+    try:
+        _drive(router, runtimes, n=3)
+        rng = np.random.RandomState(4)
+        prompts = [rng.randint(0, VOCAB, 5).astype(np.int32)
+                   for _ in range(5)]
+        handles = [router.submit(p, 6) for p in prompts]
+        _drive_until_terminal(router, runtimes, handles)
+        for p, h in zip(prompts, handles):
+            assert h.status == "done"
+            assert h.tokens == _oracle(params, mesh, p, 6)
+        # prefill staged and recycled; decode pools drained
+        for rt in runtimes:
+            alloc = rt.pool.allocator
+            alloc.check_invariants()
+            assert alloc.busy_count == 0 and alloc.reserved_count == 0
+        m = router.metrics()
+        assert m["fleet/dispatched_total"] == 5
+    finally:
+        for rt in runtimes:
+            rt.finished = True
+        router.close()
