@@ -55,6 +55,7 @@ from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 from .. import observability as obs
+from ..communicators.base import DcnLaneError
 from ..observability import flight as _flight
 from ..observability.slo import (GoodputLedger, ReservoirSample,
                                  SLOTracker, percentile_of)
@@ -217,6 +218,10 @@ class FleetRouter(RouterBase):
         self.goodput = GoodputLedger()
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
+        #: set to the error string when the started router thread died
+        #: — submit() then rejects machine-readably instead of
+        #: accepting requests nobody will ever pump
+        self._router_dead: Optional[str] = None
         _flight.register_provider("fleet_health", self.introspect_state)
 
     # ------------------------------------------------------------------
@@ -260,6 +265,11 @@ class FleetRouter(RouterBase):
                 "rng: pass jax.random.PRNGKey(...) (the lm_generate "
                 "contract)")
         prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
+        if self._router_dead is not None:
+            self._reject(
+                "worker_lost", trace_id,
+                f"fleet router thread died: {self._router_dead}",
+                retry_after_ms=1.0, queue_depth=0)
         role = self._submit_role()
         live = self._live(role)
         if not live:
@@ -303,10 +313,56 @@ class FleetRouter(RouterBase):
         req.timestamps["submitted"] = now
         entry = {"req": req, "worker": wc.name, "attempts": 1}
         with self._lock:
-            self._inflight[trace_id] = entry
-            self._dispatched += 1
-        wc.sent_since_lease += 1
-        self._send_submit(wc, req)
+            # registration and the death handler's strand snapshot
+            # share this lock, so every accepted request is either in
+            # that snapshot (and shed) or refused here — none slips
+            # through to hang
+            dead = self._router_dead
+            if dead is None:
+                self._inflight[trace_id] = entry
+                self._dispatched += 1
+                # locked with its peers: submit threads, the supervisor
+                # (failover, lease reset) all read-modify-write this
+                wc.sent_since_lease += 1
+        if dead is not None:
+            self._reject(
+                "worker_lost", trace_id,
+                f"fleet router thread died: {dead}",
+                retry_after_ms=1.0, queue_depth=0)
+        try:
+            self._send_submit(wc, req)
+        except Exception as e:  # noqa: BLE001 — no half-registered state
+            with self._lock:
+                # roll back ONLY while we still own the entry: a long
+                # retrying send can lose the race to the supervisor's
+                # orphan sweep, which may have already failed the entry
+                # over to a survivor (or shed it) — popping it then
+                # would orphan the redispatched request's result
+                cur = self._inflight.get(trace_id)
+                owned = (cur is entry and entry["attempts"] == 1
+                         and entry["worker"] == wc.name)
+                if owned:
+                    self._inflight.pop(trace_id, None)
+                    # never dispatched: rolling both back keeps the
+                    # offered count (dispatched + rejected) at one per
+                    # request and the worker's estimated depth honest
+                    self._dispatched -= 1
+                    wc.sent_since_lease = max(
+                        wc.sent_since_lease - 1, 0)
+            if not owned:
+                _flight.note("fleet", event="submit_send_superseded",
+                             trace_id=trace_id, error=str(e))
+                return RequestHandle(req)
+            if isinstance(e, DcnLaneError):
+                # the uniform machine-readable rejection instead of a
+                # raw lane fault: the caller can submit_with_retry it
+                self._reject(
+                    "worker_lost", trace_id,
+                    f"control-lane send to worker {wc.name} failed "
+                    f"permanently: {e}",
+                    retry_after_ms=self._retry_after_ms(),
+                    queue_depth=fleet_depth)
+            raise
         obs.async_event("b", "request", trace_id, cat="serving_request",
                         request=req.id, prompt_len=req.prompt_len)
         _flight.note("fleet", event="dispatched", trace_id=trace_id,
@@ -563,7 +619,9 @@ class FleetRouter(RouterBase):
                 wc.judged_seq = int(lease.get("seq", -1))
                 if self.fence.admit(wc.name, lease.get("epoch", -1),
                                     "lease"):
-                    wc.observe_lease(lease)
+                    with self._lock:   # resets sent_since_lease, which
+                        # submit threads increment under the same lock
+                        wc.observe_lease(lease)
                     if wc.state == "starting":
                         wc.state = "live"
                         wc.breaker.record_success()
@@ -582,6 +640,30 @@ class FleetRouter(RouterBase):
                     self._mark_dead(
                         wc, f"never published a lease within the "
                             f"start grace ({self.start_grace_s}s)")
+        self._sweep_orphaned_inflight()
+
+    def _sweep_orphaned_inflight(self) -> None:
+        """Fail over in-flight entries owned by a dead/drained worker.
+
+        Closes the submit/_mark_dead TOCTOU: a client thread can
+        snapshot a live worker, lose the race to the supervisor (which
+        enumerates ``_inflight`` for failover BEFORE the entry exists),
+        and then register+send to the corpse — without this sweep that
+        request would hang forever with its worker never re-judged.
+        Runs on the supervisor thread only, like every other
+        ``_failover`` call site, so an entry cannot be failed over
+        twice concurrently."""
+        dead_states = ("dead", "drained")
+        with self._lock:
+            orphans = [
+                e for e in self._inflight.values()
+                if getattr(self.workers.get(e["worker"]), "state", None)
+                in dead_states]
+        for entry in orphans:
+            wc = self.workers[entry["worker"]]
+            self._failover(
+                entry, f"dispatch raced worker {wc.name} going "
+                       f"{wc.state} (orphan sweep)")
 
     def _readmit(self, wc: WorkerClient) -> None:
         wc.epoch = self.fence.new_epoch(wc.name)
@@ -631,8 +713,22 @@ class FleetRouter(RouterBase):
         role = self._submit_role()
         survivors = [w for w in self._live(role)
                      if w.name != entry["worker"]]
-        if survivors and entry["attempts"] < 1 + self.max_failover_attempts:
-            entry["attempts"] += 1
+        with self._lock:
+            # ownership test + attempts bump are ATOMIC with the
+            # submit-path rollback's (membership, attempts==1) check:
+            # either the rollback pops first and we bail here, or we
+            # bump first and the rollback sees a disowned entry — a
+            # half-raced entry can never be both rejected to its caller
+            # AND redispatched to a survivor
+            if self._inflight.get(req.trace_id) is not entry:
+                return {"trace_id": req.trace_id,
+                        "outcome": "already_resolved"}
+            redispatch = bool(
+                survivors
+                and entry["attempts"] < 1 + self.max_failover_attempts)
+            if redispatch:
+                entry["attempts"] += 1
+        if redispatch:
             entry["install_nacks"] = 0     # fresh budget per attempt
             # any slab the dead attempt published is superseded by the
             # re-prefill; drop it from the lane store (no-op for
@@ -643,23 +739,64 @@ class FleetRouter(RouterBase):
             # measured end to end
             req.tokens = []
             req.timestamps.pop("first_token", None)
-            wc = min(survivors,
-                     key=lambda w: int((w.last_lease or {}).get(
-                         "queue_depth", 0)) + w.sent_since_lease)
-            entry["worker"] = wc.name
-            wc.sent_since_lease += 1
-            self._send_submit(wc, req)
-            with self._lock:
-                self._redispatched += 1
-            _flight.note("fleet", event="redispatched",
-                         trace_id=req.trace_id, to=wc.name,
-                         attempt=entry["attempts"], why=why)
-            return {"trace_id": req.trace_id, "outcome": "redispatched",
-                    "to": wc.name}
+            # least-loaded first, but a failed send must not shed while
+            # a healthy survivor remains untried — and an unhandled
+            # raise here would kill the supervisor/router thread and
+            # silently wedge the WHOLE fleet (no pump, no detection)
+            order = sorted(
+                survivors,
+                key=lambda w: int((w.last_lease or {}).get(
+                    "queue_depth", 0)) + w.sent_since_lease)
+            for wc in order:
+                with self._lock:
+                    entry["worker"] = wc.name
+                    wc.sent_since_lease += 1
+                try:
+                    self._send_submit(wc, req)
+                except Exception as e:  # noqa: BLE001
+                    # un-dispatch: keep this survivor's depth estimate
+                    # honest (mirrors the submit-path rollback)
+                    with self._lock:
+                        wc.sent_since_lease = max(
+                            wc.sent_since_lease - 1, 0)
+                    _flight.note("fleet", event="failover_send_failed",
+                                 trace_id=req.trace_id, to=wc.name,
+                                 error=str(e))
+                    why = (f"{why}; re-dispatch send to {wc.name} "
+                           f"failed: {e}")
+                    continue
+                with self._lock:
+                    self._redispatched += 1
+                _flight.note("fleet", event="redispatched",
+                             trace_id=req.trace_id, to=wc.name,
+                             attempt=entry["attempts"], why=why)
+                return {"trace_id": req.trace_id,
+                        "outcome": "redispatched", "to": wc.name}
+        return self._shed_entry(
+            entry,
+            f"{why}; not re-dispatched ({entry['attempts']} attempt(s) "
+            f"used, {len(survivors)} survivor(s))")
+
+    def _shed_entry(self, entry: Dict[str, Any],
+                    why: str) -> Dict[str, Any]:
+        """Terminal machine-readable shed of one in-flight entry (the
+        no-re-dispatch half of :meth:`_failover`, also called directly
+        when re-dispatch is pointless — e.g. the router thread died and
+        nobody will ever pump a result again)."""
+        req = entry["req"]
+        with self._lock:
+            # claim-or-bail: a concurrent submit rollback may have
+            # already resolved this entry to its caller — shedding it
+            # again would finish the request twice and double-count
+            if self._inflight.get(req.trace_id) is not entry:
+                return {"trace_id": req.trace_id,
+                        "outcome": "already_resolved"}
+            self._inflight.pop(req.trace_id)
+            self._rejected["worker_lost"] = \
+                self._rejected.get("worker_lost", 0) + 1
+            self._shed_inflight += 1
         shed = AdmissionError(
-            "worker_lost",
-            f"{why}; no retry budget ({entry['attempts']} attempt(s), "
-            f"{len(survivors)} survivor(s))",
+            "worker_lost", why,
             retry_after_ms=self._retry_after_ms(),
             queue_depth=sum(
                 int((w.last_lease or {}).get("queue_depth", 0))
@@ -667,11 +804,6 @@ class FleetRouter(RouterBase):
         req.shed_payload = shed.to_dict()
         req.finish("shed", time.monotonic())
         self._gc_slab(f"slab/{req.trace_id}")
-        with self._lock:
-            self._inflight.pop(req.trace_id, None)
-            self._rejected["worker_lost"] = \
-                self._rejected.get("worker_lost", 0) + 1
-            self._shed_inflight += 1
         if self.metrics_writer is not None:
             self.metrics_writer.write(
                 dict(reason="worker_lost", trace_id=req.trace_id,
@@ -746,9 +878,39 @@ class FleetRouter(RouterBase):
         self._stop.clear()
 
         def loop():
-            while not self._stop.is_set():
-                if self.step() == 0:
-                    time.sleep(poll_s)
+            try:
+                while not self._stop.is_set():
+                    if self.step() == 0:
+                        time.sleep(poll_s)
+            except BaseException as e:  # noqa: BLE001
+                # the PR 9 driver discipline: a dying router thread is
+                # LOUD and BOUNDED — note + bundle + stop flag, every
+                # in-flight request shed machine-readably (nobody will
+                # ever pump a result again) and further submits
+                # rejected, never a silent half-wedged fleet with
+                # callers blocking forever
+                err = f"{type(e).__name__}: {e}"
+                self._stop.set()
+                _flight.note("fleet", event="router_thread_death",
+                             error=err)
+                with self._lock:
+                    # same lock as submit's registration: every
+                    # accepted entry is in this snapshot, every
+                    # later submit sees the flag and rejects
+                    self._router_dead = err
+                    stranded = list(self._inflight.values())
+                for entry in stranded:
+                    try:
+                        self._shed_entry(
+                            entry, f"fleet router thread died: {err}")
+                    except Exception:  # noqa: BLE001 — PER-ENTRY
+                        pass  # best-effort: one failing shed must not
+                        #     strand every remaining caller
+                if self.bundle_dir:
+                    _flight.dump_bundle(
+                        self.bundle_dir, "fleet_router_death",
+                        extra={"error": err})
+                raise
 
         self._thread = threading.Thread(target=loop, daemon=True,
                                         name="fleet-router")

@@ -68,7 +68,14 @@ class HeartbeatPublisher:
     """Worker-side half: publish this worker's lease on the lane store
     every ``beat_interval_s`` (callers invoke :meth:`maybe_beat` from
     their loop — a wedged loop then misses leases, which is exactly the
-    liveness semantics the supervisor wants to observe)."""
+    liveness semantics the supervisor wants to observe).
+
+    Thread-safe: a worker may beat from both its step loop and a side
+    heartbeat thread, so seq minting + the put serialize under a lock
+    (concurrent unlocked beats could publish duplicate/out-of-order
+    seqs and regress lease contents).  :meth:`release` latches the
+    publisher closed under the same lock, so a racing beat can never
+    resurrect the lease of a worker that just drained."""
 
     def __init__(self, store, worker: str, role: str, epoch: int,
                  beat_interval_s: float = 0.05, lane_config=None):
@@ -80,20 +87,27 @@ class HeartbeatPublisher:
         self.lane_config = lane_config
         self.seq = 0
         self._last_beat = 0.0
+        self._lock = threading.Lock()
+        self._released = False
 
-    def beat(self, **state) -> Dict[str, Any]:
-        """Publish one lease unconditionally; returns it."""
+    def beat(self, **state) -> Optional[Dict[str, Any]]:
+        """Publish one lease; returns it (None once released)."""
         from ..communicators.base import lane_call
 
-        self.seq += 1
-        lease = make_lease(self.worker, self.role, self.epoch, self.seq,
-                           **state)
-        payload = pickle.dumps(lease, protocol=pickle.HIGHEST_PROTOCOL)
-        lane_call(f"health/{self.worker}/beat",
-                  lambda: self.store.put(f"lease/{self.worker}", payload),
-                  self.lane_config)
-        self._last_beat = time.monotonic()
-        return lease
+        with self._lock:
+            if self._released:
+                return None
+            self.seq += 1
+            lease = make_lease(self.worker, self.role, self.epoch,
+                               self.seq, **state)
+            payload = pickle.dumps(lease,
+                                   protocol=pickle.HIGHEST_PROTOCOL)
+            lane_call(f"health/{self.worker}/beat",
+                      lambda: self.store.put(f"lease/{self.worker}",
+                                             payload),
+                      self.lane_config)
+            self._last_beat = time.monotonic()
+            return lease
 
     def maybe_beat(self, **state) -> Optional[Dict[str, Any]]:
         """Publish iff a beat interval elapsed since the last one."""
@@ -103,12 +117,15 @@ class HeartbeatPublisher:
 
     def release(self) -> None:
         """Graceful exit (drain): delete this worker's lease so the
-        supervisor sees an explicit departure, not a missed window."""
+        supervisor sees an explicit departure, not a missed window.
+        Latches the publisher: later beats are refused."""
         from ..communicators.base import lane_call
 
-        lane_call(f"health/{self.worker}/release",
-                  lambda: self.store.delete(f"lease/{self.worker}"),
-                  self.lane_config)
+        with self._lock:
+            self._released = True
+            lane_call(f"health/{self.worker}/release",
+                      lambda: self.store.delete(f"lease/{self.worker}"),
+                      self.lane_config)
 
 
 class LeaseTable:

@@ -17,10 +17,12 @@ protocol above it.
 
 On top of any lane store, :class:`MailboxSender`/:class:`MailboxReceiver`
 make an ordered, at-most-once message channel: every mailbox has exactly
-ONE writer (the fleet wiring guarantees it: the router writes each
-worker's control inbox, each worker writes its own outbox), so a
+ONE writer OBJECT (the fleet wiring guarantees it: the router writes
+each worker's control inbox, each worker writes its own outbox), so a
 sender-side sequence counter + receiver-side cursor give total order
-without locks or collectives.  Messages are pickled dicts stamped with
+without collectives — the sender serializes its own threads (router
+client threads and the supervisor share one control-mailbox sender)
+under a local lock.  Messages are pickled dicts stamped with
 ``MSG_SCHEMA`` — a receiver refuses a payload it cannot interpret,
 never guesses.  Every store operation goes through :func:`lane_call`,
 so retries/backoff/fault-injection ride the PR 8 discipline and a
@@ -33,6 +35,7 @@ from __future__ import annotations
 import os
 import pickle
 import tempfile
+import threading
 import time
 from typing import Any, Dict, Optional
 
@@ -41,9 +44,18 @@ MSG_SCHEMA = "chainermn_tpu.worker_lane.v1"
 
 
 def _safe_tag(tag: str) -> str:
-    """Filesystem-safe encoding of a lane tag (tags use '/' and '.')."""
-    return "".join(c if c.isalnum() or c in "-_." else f"_{ord(c):02x}"
-                   for c in str(tag))
+    """Filesystem-safe INJECTIVE encoding of a lane tag (tags use '/'
+    and '.').  ASCII alnum and '-.' pass verbatim; everything else —
+    including '_', the escape lead, and non-ASCII — becomes fixed-width
+    per-UTF-8-byte '_XX' escapes.  Fixed width matters: a variable-
+    length escape (f'_{ord(c):x}') has no terminator, so 'a\\u263a'
+    ('_263a') would alias 'a&3a' ('_26' + '3a') — caller-supplied
+    worker names must never make two distinct mailboxes/leases share
+    one lane file."""
+    return "".join(
+        c if (c.isascii() and c.isalnum()) or c in "-." else
+        "".join(f"_{b:02x}" for b in c.encode("utf-8"))
+        for c in str(tag))
 
 
 class FileLaneStore:
@@ -123,8 +135,14 @@ class MailboxSender:
     """The single writer of one named mailbox (ordered, at-most-once).
 
     ``seq`` persists only in this sender — the single-writer contract
-    makes it the mailbox's total order.  A re-created sender for a live
-    mailbox (e.g. a restarted router) must pass the old cursor via
+    makes it the mailbox's total order.  "Single writer" means one
+    SENDER OBJECT, not one thread: the router's control mailboxes are
+    written from client threads (submit) and the supervisor thread
+    (failover, drain) through the same sender, so :meth:`send` holds a
+    lock across the seq read, the put, and the increment — two
+    concurrent sends minting the same seq would have the second put
+    silently overwrite the first message.  A re-created sender for a
+    live mailbox (e.g. a restarted router) must pass the old cursor via
     ``start_seq`` or use a fresh mailbox name (a new worker epoch gets
     a new mailbox in the fleet wiring, which is what fencing wants
     anyway: a zombie's stale mailbox is simply never read again).
@@ -135,19 +153,22 @@ class MailboxSender:
         self.name = str(name)
         self.config = config
         self.seq = int(start_seq)
+        self._lock = threading.Lock()
 
     def send(self, msg: Dict[str, Any]) -> int:
-        """Publish one message; returns its sequence number."""
+        """Publish one message; returns its sequence number.
+        Thread-safe: concurrent sends serialize and get distinct seqs."""
         from ..communicators.base import lane_call
 
-        seq = self.seq
-        payload = pickle.dumps(
-            dict(msg, schema=MSG_SCHEMA, seq=seq),
-            protocol=pickle.HIGHEST_PROTOCOL)
-        tag = f"mbx/{self.name}/{seq}"
-        lane_call(f"worker_lane/{self.name}/send",
-                  lambda: self.store.put(tag, payload), self.config)
-        self.seq = seq + 1
+        with self._lock:
+            seq = self.seq
+            payload = pickle.dumps(
+                dict(msg, schema=MSG_SCHEMA, seq=seq),
+                protocol=pickle.HIGHEST_PROTOCOL)
+            tag = f"mbx/{self.name}/{seq}"
+            lane_call(f"worker_lane/{self.name}/send",
+                      lambda: self.store.put(tag, payload), self.config)
+            self.seq = seq + 1
         return seq
 
 

@@ -447,7 +447,10 @@ class WorkerRuntime:
             self.heart.release()
             _flight.note("worker", event="drained", worker=self.name)
             return worked + 1
-        self.heart.maybe_beat(**self._lease_state())
+        if self._beat_thread is None:
+            # with the side thread active it owns the lease cadence —
+            # beating from here too would interleave two publishers
+            self.heart.maybe_beat(**self._lease_state())
         self._steps += 1
         self._t_last_step = time.monotonic()
         return worked

@@ -95,6 +95,61 @@ def test_mailbox_refuses_foreign_schema(tmp_path):
         rx.recv()
 
 
+def test_mailbox_concurrent_sends_lose_nothing(tmp_path):
+    """One sender object, many threads (the router's real shape:
+    client submit threads + the supervisor thread share each worker's
+    control-mailbox sender) — every message gets a distinct seq, none
+    is overwritten, per-thread order survives the interleaving."""
+    import threading
+
+    store = FileLaneStore(str(tmp_path))
+    tx = MailboxSender(store, "ctl.w0")
+    rx = MailboxReceiver(store, "ctl.w0")
+    n_threads, per_thread = 8, 25
+    barrier = threading.Barrier(n_threads)
+
+    def blast(i):
+        barrier.wait()
+        for j in range(per_thread):
+            tx.send({"kind": "submit", "src": i, "j": j})
+
+    threads = [threading.Thread(target=blast, args=(i,))
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    got = []
+    while True:
+        batch = rx.drain(limit=512)
+        if not batch:
+            break
+        got.extend(batch)
+    assert len(got) == n_threads * per_thread
+    assert [m["seq"] for m in got] == list(range(n_threads * per_thread))
+    for i in range(n_threads):
+        assert [m["j"] for m in got if m["src"] == i] == \
+            list(range(per_thread))
+
+
+def test_safe_tag_injective(tmp_path):
+    """Caller-supplied worker names must never make two distinct tags
+    share one lane file: a literal '_2f' must not alias an encoded
+    '/', and a multi-byte codepoint must not alias an escape followed
+    by literal hex digits (fixed-width per-byte escapes)."""
+    store = FileLaneStore(str(tmp_path))
+    pairs = [("lease/a_2fb", "lease/a/b"),
+             ("lease/a☺", "lease/a&3a")]
+    for left, right in pairs:
+        store.put(left, b"L")
+        store.put(right, b"R")
+        assert store.get(left, timeout_s=0.0) == b"L"
+        assert store.get(right, timeout_s=0.0) == b"R"
+    # pure ASCII-safe tags stay verbatim-readable on disk
+    from chainermn_tpu.serving.lanes import _safe_tag
+    assert _safe_tag("mbx/ctl.w0/12") == "mbx_2fctl.w0_2f12"
+
+
 # ---------------------------------------------------------------------------
 # health-plane units (no jax)
 # ---------------------------------------------------------------------------
@@ -121,6 +176,24 @@ def test_epoch_fence_refuses_and_counts():
     assert counts == {"token": 1, "lease": 1, "slab_ready": 1,
                       "result": 1}
     assert not fence.admit("unknown", 1, "lease")     # never admitted
+
+
+def test_heartbeat_release_latches(tmp_path):
+    """release() latches the publisher closed: a racing beat (the side
+    heartbeat thread vs the drain path) can never resurrect the lease
+    of a worker that just drained."""
+    from chainermn_tpu.serving.health import (HeartbeatPublisher,
+                                              LeaseTable)
+
+    store = FileLaneStore(str(tmp_path))
+    heart = HeartbeatPublisher(store, "w0", "engine", 1,
+                               beat_interval_s=0.0)
+    assert heart.beat(queue_depth=0)["seq"] == 1
+    assert LeaseTable(store).read("w0")["seq"] == 1
+    heart.release()
+    assert heart.beat(queue_depth=0) is None
+    assert heart.maybe_beat(queue_depth=0) is None
+    assert LeaseTable(store).read("w0") is None   # stays deleted
 
 
 def test_circuit_breaker_backoff_and_budget():
@@ -348,6 +421,264 @@ def test_kill_failover_exactly_one_outcome(local_fleet):
     assert "out.engine0" in rep["worker_lost"]["lane"]
     assert rep["worker_lost"]["redispatched"] \
         + rep["worker_lost"]["shed"] == len(traced)
+
+
+def test_orphan_sweep_rescues_entry_on_dead_worker(local_fleet):
+    """The submit/_mark_dead TOCTOU, reproduced deterministically: a
+    client thread snapshots a live worker, the supervisor marks it dead
+    (its failover enumeration sees no entry yet), THEN the client
+    registers its entry on the corpse.  The supervisor's orphan sweep
+    must fail it over — the request terminates token-exact on the
+    survivor instead of hanging forever."""
+    params, mesh, router, runtimes, _ = local_fleet
+    _drive(router, runtimes, n=3)
+    prompt = (np.arange(5) % VOCAB).astype(np.int32)
+    h = router.submit(prompt, 6)
+    with router._lock:
+        trace_id, entry = next(iter(router._inflight.items()))
+        # lift the entry out: _mark_dead must enumerate an EMPTY
+        # registry, exactly what the racing supervisor sees
+        router._inflight.pop(trace_id)
+    victim = entry["worker"]
+    rt_victim = next(rt for rt in runtimes if rt.name == victim)
+    survivors = [rt for rt in runtimes if rt.name != victim]
+    rt_victim.kill()
+    t0 = time.time()
+    while router.workers[victim].state != "dead":
+        assert time.time() - t0 < 30, "death never detected"
+        _drive(router, runtimes, live=survivors)
+        time.sleep(0.001)
+    assert router.last_detection["in_flight"] == []   # race: saw none
+    # the losing submit now lands its entry on the corpse
+    with router._lock:
+        router._inflight[trace_id] = entry
+    _drive_until_terminal(router, runtimes, [h], live=survivors)
+    assert h.status == "done"
+    assert h.tokens == _oracle(params, mesh, prompt, 6)
+    assert router.metrics()["fleet/redispatched_total"] >= 1
+
+
+def test_submit_send_failure_rejects_cleanly(local_fleet):
+    """A permanent control-lane fault during submit's send must not
+    leak the freshly registered in-flight entry: the caller gets the
+    uniform machine-readable worker_lost rejection and the router's
+    registry stays clean (no phantom request, busy drops false)."""
+    from chainermn_tpu.communicators.base import set_lane_fault_injector
+
+    params, mesh, router, runtimes, _ = local_fleet
+    _drive(router, runtimes, n=3)
+
+    def injector(lane, attempt):
+        if lane.startswith("worker_lane/ctl.") and lane.endswith("/send"):
+            raise RuntimeError("assertion failed: injected lane fault")
+
+    set_lane_fault_injector(injector)
+    try:
+        with pytest.raises(AdmissionError) as e:
+            router.submit((np.arange(5) % VOCAB).astype(np.int32), 6)
+    finally:
+        set_lane_fault_injector(None)
+    pay = e.value.to_dict()
+    assert pay["reason"] == "worker_lost"
+    assert pay["retry_after_ms"] >= 1.0
+    assert router.requests_table()["in_flight"] == []   # no leak
+    assert not router.busy
+    # the never-dispatched request counts ONCE (as a rejection): both
+    # the dispatch counter and the worker's depth estimate rolled back
+    m = router.metrics()
+    assert m["fleet/dispatched_total"] == 0
+    assert m["fleet/rejected/worker_lost"] == 1
+    assert m["fleet/shed_rate"] == 1.0      # offered=1, rejected=1
+    assert all(wc.sent_since_lease == 0
+               for wc in router.workers.values())
+    # the fleet still serves once the fault clears
+    h = router.submit((np.arange(5) % VOCAB).astype(np.int32), 4)
+    _drive_until_terminal(router, runtimes, [h])
+    assert h.status == "done"
+
+
+def test_failover_send_failure_sheds_instead_of_crashing(local_fleet):
+    """A permanent control-lane fault during _failover's re-dispatch
+    send must not propagate out of the supervisor tick (in the started
+    router that raise kills the router thread and wedges the whole
+    fleet): the request is shed machine-readably and the router keeps
+    supervising."""
+    from chainermn_tpu.communicators.base import set_lane_fault_injector
+
+    params, mesh, router, runtimes, _ = local_fleet
+    _drive(router, runtimes, n=3)
+    h = router.submit((np.arange(5) % VOCAB).astype(np.int32), 6)
+    with router._lock:
+        entry = next(iter(router._inflight.values()))
+    victim = entry["worker"]
+    rt_victim = next(rt for rt in runtimes if rt.name == victim)
+    survivors = [rt for rt in runtimes if rt.name != victim]
+    rt_victim.kill()
+
+    def injector(lane, attempt):
+        if lane.startswith("worker_lane/ctl.") and lane.endswith("/send"):
+            raise RuntimeError("assertion failed: injected lane fault")
+
+    set_lane_fault_injector(injector)
+    try:
+        _drive_until_terminal(router, runtimes, [h], live=survivors)
+    finally:
+        set_lane_fault_injector(None)
+    assert h.finish_reason == "shed"
+    assert h.shed_payload["reason"] == "worker_lost"
+    assert router.requests_table()["in_flight"] == []
+    # the supervisor survived: it still detects and still serves
+    h2 = router.submit((np.arange(5) % VOCAB).astype(np.int32), 4)
+    _drive_until_terminal(router, runtimes, [h2], live=survivors)
+    assert h2.status == "done"
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_router_thread_death_is_bounded_and_loud(local_fleet):
+    """A permanent store fault escaping the started router thread's
+    loop must not leave a silent half-wedged fleet: every in-flight
+    request is shed machine-readably (its caller unblocks), later
+    submits reject with the uniform payload, and a fleet_router_death
+    bundle is dumped."""
+    from chainermn_tpu.communicators.base import set_lane_fault_injector
+    from chainermn_tpu.observability.flight import find_bundles
+
+    params, mesh, router, runtimes, bundles = local_fleet
+    _drive(router, runtimes, n=3)
+    # in-flight forever: the workers are deliberately never driven
+    h = router.submit((np.arange(5) % VOCAB).astype(np.int32), 8)
+    router.start(poll_s=0.001)
+
+    def injector(lane, attempt):
+        if lane.startswith("worker_lane/out.") and lane.endswith("/recv"):
+            raise RuntimeError("assertion failed: injected store fault")
+
+    set_lane_fault_injector(injector)
+    try:
+        t0 = time.time()
+        while router._thread.is_alive():
+            assert time.time() - t0 < 30, "router thread never died"
+            time.sleep(0.005)
+    finally:
+        set_lane_fault_injector(None)
+    assert h.finish_reason == "shed"
+    assert h.shed_payload["reason"] == "worker_lost"
+    assert "router thread died" in h.shed_payload["detail"]
+    assert router.requests_table()["in_flight"] == []
+    with pytest.raises(AdmissionError) as e:
+        router.submit((np.arange(5) % VOCAB).astype(np.int32), 4)
+    assert e.value.reason == "worker_lost"
+    assert "router thread died" in str(e.value)
+    assert any("fleet_router_death" in os.path.basename(p)
+               for p in find_bundles(bundles))
+
+
+def test_sweep_supersedes_blocked_submit_send(local_fleet):
+    """The sweep/rollback lost-update race: submit registers its entry,
+    then blocks inside the lane send long enough for the supervisor to
+    mark the worker dead and fail the entry over to a survivor.  When
+    the blocked send finally fails, the rollback must see it no longer
+    owns the entry and return the handle — popping it would orphan the
+    redispatched request's result."""
+    import threading
+
+    from chainermn_tpu.communicators.base import set_lane_fault_injector
+
+    params, mesh, router, runtimes, _ = local_fleet
+    _drive(router, runtimes, n=3)
+    # a fresh router's first submit deterministically picks the first
+    # registered worker (depth tie + round-robin offset 0)
+    victim = next(iter(router.workers))
+    rt_victim = next(rt for rt in runtimes if rt.name == victim)
+    survivors = [rt for rt in runtimes if rt.name != victim]
+    release = threading.Event()
+
+    def injector(lane, attempt):
+        if lane == f"worker_lane/ctl.{victim}/send":
+            assert release.wait(30), "test never released the send"
+            raise RuntimeError("assertion failed: fault after sweep")
+
+    prompt = (np.arange(5) % VOCAB).astype(np.int32)
+    out = {}
+
+    def do_submit():
+        try:
+            out["handle"] = router.submit(prompt, 6)
+        except Exception as e:  # noqa: BLE001
+            out["error"] = e
+
+    set_lane_fault_injector(injector)
+    try:
+        t = threading.Thread(target=do_submit)
+        t.start()
+        t0 = time.time()
+        while not router._inflight:        # registered, blocked in send
+            assert time.time() - t0 < 30
+            time.sleep(0.001)
+        rt_victim.kill()
+        while router.metrics()["fleet/redispatched_total"] < 1:
+            assert time.time() - t0 < 30, "sweep never redispatched"
+            _drive(router, runtimes, live=survivors)
+            time.sleep(0.001)
+        release.set()
+        t.join(timeout=30)
+        assert not t.is_alive()
+    finally:
+        set_lane_fault_injector(None)
+        release.set()
+    assert "error" not in out, out.get("error")
+    h = out["handle"]
+    _drive_until_terminal(router, runtimes, [h], live=survivors)
+    assert h.status == "done"
+    assert h.tokens == _oracle(params, mesh, prompt, 6)
+    m = router.metrics()
+    assert m["fleet/dispatched_total"] == 1     # no rollback fired
+    assert m["fleet/rejected_total"] == 0
+
+
+def test_failover_tries_other_survivors_before_shedding(devices):
+    """One survivor's control lane permanently faulted, another healthy:
+    failover must walk past the broken lane and complete token-exact on
+    the healthy survivor instead of shedding with budget remaining."""
+    from chainermn_tpu.communicators.base import set_lane_fault_injector
+    from chainermn_tpu.serving.fleet import build_local_fleet
+
+    params = _params()
+    mesh = _mesh(devices)
+    router, runtimes = build_local_fleet(
+        params, {"engine": 3}, head_dim=HEAD_DIM,
+        beat_interval_s=0.01, miss_beats=3,
+        worker_kwargs=dict(n_slots=2, max_total=24, mesh=mesh))
+    try:
+        _drive(router, runtimes, n=3)
+        prompt = (np.arange(5) % VOCAB).astype(np.int32)
+        h = router.submit(prompt, 6)
+        with router._lock:
+            victim = next(iter(router._inflight.values()))["worker"]
+        rt_victim = next(rt for rt in runtimes if rt.name == victim)
+        survivors = [rt for rt in runtimes if rt.name != victim]
+        # block the survivor failover tries FIRST (depth tie breaks in
+        # registration order, same order the failover sort preserves)
+        blocked = next(n for n in router.workers if n != victim)
+        rt_victim.kill()
+
+        def injector(lane, attempt):
+            if lane == f"worker_lane/ctl.{blocked}/send":
+                raise RuntimeError("assertion failed: injected fault")
+
+        set_lane_fault_injector(injector)
+        try:
+            _drive_until_terminal(router, runtimes, [h], live=survivors)
+        finally:
+            set_lane_fault_injector(None)
+        assert h.status == "done"
+        assert h.tokens == _oracle(params, mesh, prompt, 6)
+        assert router.metrics()["fleet/redispatched_total"] == 1
+    finally:
+        for rt in runtimes:
+            rt.finished = True
+        router.close()
 
 
 def test_zombie_fencing_and_breaker_readmission(local_fleet):
