@@ -663,6 +663,76 @@ def _build_supervisor_tick() -> Dict[str, Any]:
             "variants": (probe, base["variants"][1])}
 
 
+class _AutoscaleTickProbe:
+    """Variant probe for the AUTOSCALED tick (ISSUE 11): every call
+    runs one full control-loop round around the compiled decode tick —
+    degradation-ladder update on an overload pressure signal, tenant
+    budget check + admission bookkeeping, and an
+    :class:`~chainermn_tpu.serving.autoscale.AutoscalePolicy` decision
+    over a synthetic oscillating signal trace (fake receiver clock, so
+    the probe is deterministic).  The policy tick is pure host
+    bookkeeping: it must add ZERO device traffic and ZERO compiles —
+    scaling decisions never leak into trace-time."""
+
+    def __init__(self, jfn, policy, table):
+        self._jfn = jfn
+        self._policy = policy
+        self._table = table
+        self._calls = 0
+
+    def __call__(self, *a):
+        from chainermn_tpu.observability import flight
+        from chainermn_tpu.serving.scheduler import Request
+
+        self._calls += 1
+        now = float(self._calls)          # fake receiver clock
+        # oscillating synthetic load: hysteresis must absorb it
+        backlog = 512 if self._calls % 2 else 0
+        self._table.ladder.update(0.5 if backlog else 0.0, now=now)
+        tenant = self._table.resolve("analysis-tenant", "best_effort")
+        refused = self._table.admission_check(tenant, now=now)
+        if refused is None:
+            self._table.on_admit(tenant, Request([1], 1), capped=False)
+        out = self._jfn(*a)
+        dec = self._policy.decide(
+            {"live_workers": 1, "backlog_tokens": backlog,
+             "queue_depth": 4 if backlog else 0, "shed_rate": 0.0},
+            now)
+        if dec is not None:
+            flight.note("autoscale_decision",
+                        **{k: v for k, v in dec.items()
+                           if k != "event"})
+        flight.note("phase", name="fleet/autoscale_tick")
+        return out
+
+    def _cache_size(self):
+        return self._jfn._cache_size()
+
+
+def _build_autoscale_tick() -> Dict[str, Any]:
+    """The serving decode tick as the AUTOSCALED fleet runs it
+    (ISSUE 11): ladder update + tenant budget bookkeeping + one policy
+    decision per call, all host-side.  One program across value
+    variants: elasticity must never leak into trace-time."""
+    from chainermn_tpu.serving.autoscale import AutoscalePolicy
+    from chainermn_tpu.serving.tenancy import TenantTable
+
+    base = _build_decode_tick()
+    fn, args = base["trace"]
+    policy = AutoscalePolicy(min_workers=1, max_workers=2,
+                             up_cooldown_s=3.0, down_cooldown_s=6.0,
+                             down_stable_s=6.0)
+    table = TenantTable()
+    probe = _AutoscaleTickProbe(base["variants"][0], policy, table)
+
+    def run_autoscaled(*a):
+        return probe(*a)
+
+    return {"trace": (run_autoscaled, args),
+            "bound_axes": base["bound_axes"],
+            "variants": (probe, base["variants"][1])}
+
+
 class _WorkerLaneProbe:
     """Variant probe for the lane LANDING program (ISSUE 10): every
     call runs one worker-lane mailbox round trip (pickled control
@@ -850,6 +920,17 @@ ENTRYPOINTS = [
                     "read + epoch-fence admission + breaker consult — "
                     "liveness is host-side bookkeeping: one program, "
                     "zero extra device traffic (ISSUE 10)"),
+    EntryPoint(
+        name="serving.autoscale_tick",
+        build=_build_autoscale_tick,
+        shardflow=False,  # same compiled program as the decode tick —
+        #                   the base entry owns its shard-flow analysis
+        description="serving decode tick under the autoscale control "
+                    "loop: degradation-ladder update + tenant budget "
+                    "bookkeeping + one AutoscalePolicy decision per "
+                    "call over a synthetic oscillating trace — "
+                    "elasticity is host-side bookkeeping: one program, "
+                    "zero extra device traffic (ISSUE 11)"),
     EntryPoint(
         name="serving.worker_lane",
         build=_build_worker_lane,

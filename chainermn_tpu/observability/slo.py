@@ -104,6 +104,52 @@ class ReservoirSample:
         return percentile_of(self._values, q)
 
 
+class RateMeter:
+    """Sliding-window rate over a CUMULATIVE counter.
+
+    ``observe(total)`` stamps ``(t, total)``; :meth:`rate` is the delta
+    per second between the oldest in-window sample and the newest — the
+    *recent* rate a long healthy history cannot pin (the run-cumulative
+    average problem the serving SLO throughput observation already
+    solves ad hoc).  Used by the drain-aware ``retry_after_ms``
+    derivation and the autoscaler's shed-rate / offered-load signals
+    (ISSUE 11).  Pure stdlib; pass ``now`` explicitly for
+    receiver-clocked deterministic tests.
+    """
+
+    def __init__(self, window_s: float = 5.0,
+                 clock: Callable[[], float] = time.monotonic):
+        if window_s <= 0:
+            raise ValueError(f"window_s must be > 0, got {window_s}")
+        self.window_s = float(window_s)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._samples: deque = deque()   # (t, total)
+
+    def observe(self, total: float, now: Optional[float] = None) -> None:
+        t = self._clock() if now is None else float(now)
+        with self._lock:
+            self._samples.append((t, float(total)))
+            # keep ONE sample older than the window so rate() always
+            # spans at least window_s once enough history exists
+            cutoff = t - self.window_s
+            while len(self._samples) > 2 and self._samples[1][0] < cutoff:
+                self._samples.popleft()
+
+    def rate(self, now: Optional[float] = None) -> float:
+        """Counter delta per second over the retained window (0.0 until
+        two samples with distinct timestamps exist — callers treat that
+        as "no throughput measured yet", the zero-throughput edge the
+        retry derivation clamps)."""
+        with self._lock:
+            if len(self._samples) < 2:
+                return 0.0
+            (t0, v0), (t1, v1) = self._samples[0], self._samples[-1]
+        if t1 <= t0:
+            return 0.0
+        return max(v1 - v0, 0.0) / (t1 - t0)
+
+
 class GoodputLedger:
     """Wall-time partition into attribution buckets.
 
@@ -281,6 +327,18 @@ class SLOTracker:
             return None
         budget = 1.0 - self.objective
         return (bad / total) / budget
+
+    def short_window_burn(self, metrics: Tuple[str, ...] = ("ttft",
+                                                            "throughput")
+                          ) -> Optional[float]:
+        """Worst short-window burn across ``metrics`` (None when no
+        metric has enough observations) — THE overload scalar the shed
+        gate, the degradation ladder, and the autoscaler all read; one
+        definition so they can never disagree on what "burning" means
+        (ISSUE 11)."""
+        burns = [self.burn_rate(m, self.windows_s[0]) for m in metrics]
+        burns = [b for b in burns if b is not None]
+        return max(burns) if burns else None
 
     def _check(self, metric: str, value: float, target: float) -> None:
         short, long_ = self.windows_s

@@ -109,6 +109,21 @@ def main(argv=None):
     parser.add_argument("--lane-dir", default=None,
                         help="directory for the cross-process file "
                              "lanes (default: a fresh temp dir)")
+    parser.add_argument("--autoscale", default=None, metavar="MIN:MAX",
+                        help="with --fleet-procs: attach the ISSUE 11 "
+                             "load-driven autoscaler (scale-up spawns "
+                             "worker processes, scale-down always "
+                             "drains; e.g. --autoscale 1:4); decisions "
+                             "land as autoscale_decision flight events "
+                             "and in the summary")
+    parser.add_argument("--tenants", action="store_true",
+                        help="two-tenant QoS demo (ISSUE 11): even "
+                             "requests bill to tenant 'gold' (paid), "
+                             "odd to 'free' (best_effort, budgeted) — "
+                             "the summary carries per-tenant "
+                             "goodput/TTFT/shed attribution; needs a "
+                             "router topology (--replicas/--disagg/"
+                             "--fleet-procs)")
     parser.add_argument("--beat-interval-s", type=float, default=0.05,
                         help="worker heartbeat interval; the router "
                              "declares death after miss_beats=4 missed "
@@ -244,7 +259,39 @@ def main(argv=None):
     router = None
     disagg = None
     fleet = None
+    autoscaler = None
     n_p = n_d = 0
+    tenancy = None
+    if args.tenants:
+        if args.replicas <= 1 and not args.disagg and not args.fleet_procs:
+            raise SystemExit("--tenants needs a router topology "
+                             "(--replicas N / --disagg P:D / "
+                             "--fleet-procs N) — the tenant plane lives "
+                             "at the router's admission gate")
+        from chainermn_tpu.serving import TenantTable
+        tenancy = TenantTable()
+        tenancy.register("gold", "paid")
+        # the best-effort tenant carries a modest concurrency budget so
+        # the demo shows budget sheds under the staggered burst
+        tenancy.register("free", "best_effort",
+                         max_inflight=max(args.n_slots // 2, 1))
+    autoscale_range = None
+    if args.autoscale:
+        # validated BEFORE build_proc_fleet: failing after the spawn
+        # would leak orphaned worker processes on the SystemExit
+        if not args.fleet_procs:
+            raise SystemExit("--autoscale drives the cross-process "
+                             "fleet: combine it with --fleet-procs N")
+        try:
+            autoscale_range = tuple(
+                int(x) for x in args.autoscale.split(":"))
+        except ValueError:
+            raise SystemExit(f"--autoscale wants MIN:MAX (e.g. 1:4), "
+                             f"got {args.autoscale!r}")
+        if len(autoscale_range) != 2 \
+                or not 1 <= autoscale_range[0] <= autoscale_range[1]:
+            raise SystemExit(f"--autoscale needs 1 <= MIN <= MAX, "
+                             f"got {args.autoscale!r}")
     if args.disagg:
         if args.replicas > 1:
             raise SystemExit("--disagg and --replicas > 1 are mutually "
@@ -277,9 +324,25 @@ def main(argv=None):
                 n_slots=args.n_slots,
                 max_total=eng_kwargs["max_total"],
                 queue_capacity=args.queue_capacity),
-            slo=slo, metrics_writer=writer)
+            slo=slo, metrics_writer=writer, tenancy=tenancy)
         print(f"fleet: spawned {topology} worker process(es), lanes at "
               f"{lane_dir}", file=sys.stderr)
+        if autoscale_range is not None:
+            lo, hi = autoscale_range
+            from chainermn_tpu.serving.autoscale import (
+                AutoscalePolicy, FleetAutoscaler, proc_spawn_factory)
+            autoscaler = FleetAutoscaler(
+                fleet,
+                proc_spawn_factory(
+                    lane_dir, os.path.join(lane_dir, "fleet_params.pkl"),
+                    beat_interval_s=args.beat_interval_s,
+                    bundle_dir=args.flight_dump_dir),
+                policies=[AutoscalePolicy(
+                    role=role, min_workers=lo, max_workers=hi)
+                    for role in topology],
+                metrics_writer=writer)
+            print(f"autoscale: {args.autoscale} attached "
+                  f"(scale-down is always a drain)", file=sys.stderr)
         eng = None
     elif args.disagg:
         from chainermn_tpu.serving import build_disagg_fleet
@@ -289,7 +352,7 @@ def main(argv=None):
             n_slots=args.n_slots, mesh=serve_mesh,
             queue_capacity=args.queue_capacity,
             transport_mode=args.transport, slo=slo,
-            metrics_writer=writer,
+            metrics_writer=writer, tenancy=tenancy,
             bundle_dir=args.flight_dump_dir)
         eng = None
     elif args.replicas > 1:
@@ -298,7 +361,8 @@ def main(argv=None):
         # budget) and the router owns the JSONL writer (router_rejection
         # + router_summary records ride the serving stream)
         router = build_fleet(trained, args.replicas, slo=slo,
-                             metrics_writer=writer, **eng_kwargs)
+                             metrics_writer=writer, tenancy=tenancy,
+                             **eng_kwargs)
         eng = None
     else:
         eng = ServingEngine(trained, metrics_writer=writer, slo=slo,
@@ -346,12 +410,15 @@ def main(argv=None):
             service.step()
 
     def submit(i):
+        tenant_kw = {}
+        if tenancy is not None:
+            tenant_kw = {"tenant": "gold" if i % 2 == 0 else "free"}
         try:
             handles[i] = submit_with_retry(
                 service.submit, prompts[i], args.max_new_tokens,
                 max_attempts=max(args.submit_retries, 1),
                 sleep=driving_sleep, on_token=stream,
-                **sample_kw.get(i, {}))
+                **tenant_kw, **sample_kw.get(i, {}))
         except AdmissionError as e:
             rejected[i] = e.to_dict()
             print(f"request {i} rejected after "
@@ -412,6 +479,8 @@ def main(argv=None):
               f"(true continuation {want[i].tolist()})", file=sys.stderr)
 
     fleet_exit_codes = None
+    if autoscaler is not None:
+        autoscaler.stop()
     if fleet is not None:
         # graceful ROLLING drain (the ISSUE 10 acceptance: in-flight
         # work finishes, nothing sheds, every worker exits 0)
@@ -467,6 +536,10 @@ def main(argv=None):
     }
     if slo is not None:
         summary["slo"] = slo.status()
+    if tenancy is not None:
+        summary["tenancy"] = tenancy.state()
+    if autoscaler is not None:
+        summary["autoscale"] = autoscaler.state()
     print(json.dumps(summary))
     return 0
 

@@ -88,9 +88,16 @@ from .scheduler import (  # noqa: F401
 )
 from .cache_pool import SlotAllocator  # noqa: F401
 from .prefix_cache import PrefixCache, PrefixEntry  # noqa: F401
+from .tenancy import (  # noqa: F401 — jax-free, like the scheduler
+    DegradationLadder,
+    Tenant,
+    TenantTable,
+)
 
 __all__ = ["AdmissionError", "Request", "Scheduler", "SlotAllocator",
            "PrefixCache", "PrefixEntry",
+           "TenantTable", "Tenant", "DegradationLadder",
+           "AutoscalePolicy", "FleetAutoscaler", "derive_retry_after_ms",
            "ServingEngine", "RequestHandle", "CachePool", "DecodeEngine",
            "Replica", "ServingRouter", "build_fleet",
            "KvTransferPlane", "DisaggRouter", "PrefillWorker",
@@ -136,4 +143,8 @@ def __getattr__(name):
                 "build_local_fleet", "submit_with_retry"):
         from . import fleet
         return getattr(fleet, name)
+    if name in ("AutoscalePolicy", "FleetAutoscaler",
+                "derive_retry_after_ms"):
+        from . import autoscale
+        return getattr(autoscale, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

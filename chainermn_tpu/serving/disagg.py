@@ -373,6 +373,8 @@ class DisaggRouter(RouterBase):
                  transport_mode: str = "local",
                  slo: Optional[SLOTracker] = None,
                  shed_burn_threshold: float = 1.0,
+                 tenancy=None,
+                 paid_burn_headroom: float = 2.0,
                  default_token_latency_ms: float = 20.0,
                  metrics_writer=None,
                  max_transfer_attempts: int = 2,
@@ -383,7 +385,11 @@ class DisaggRouter(RouterBase):
         if transport_mode not in ("local", "lanes"):
             raise ValueError(f"transport_mode must be local|lanes, "
                              f"got {transport_mode!r}")
-        super().__init__(metrics_writer=metrics_writer)
+        super().__init__(
+            metrics_writer=metrics_writer, tenancy=tenancy, slo=slo,
+            shed_burn_threshold=shed_burn_threshold,
+            paid_burn_headroom=paid_burn_headroom,
+            default_token_latency_ms=default_token_latency_ms)
         self.prefill_workers: List[PrefillWorker] = list(prefill_workers)
         self.decode_workers: List[DecodeWorker] = list(decode_workers)
         names = [w.name for w in self.prefill_workers] \
@@ -392,9 +398,6 @@ class DisaggRouter(RouterBase):
             raise ValueError(f"worker names must be unique: {names}")
         self.plane = plane or KvTransferPlane()
         self.transport_mode = transport_mode
-        self.slo = slo
-        self.shed_burn_threshold = float(shed_burn_threshold)
-        self.default_token_latency_ms = float(default_token_latency_ms)
         self.max_transfer_attempts = int(max_transfer_attempts)
         self.bundle_dir = bundle_dir
         self.lane_timeout_s = float(lane_timeout_s)
@@ -417,13 +420,17 @@ class DisaggRouter(RouterBase):
                eos_id: Optional[int] = None,
                deadline_s: Optional[float] = None,
                on_token=None, temperature: float = 0.0,
-               rng=None) -> RequestHandle:
+               rng=None, tenant: Optional[str] = None,
+               priority: Optional[str] = None) -> RequestHandle:
         """Dispatch to the least-loaded live prefill worker or raise
         :class:`AdmissionError` with the uniform machine-readable
-        payload (reason + ``retry_after_ms`` + ``queue_depth``)."""
+        payload (reason + ``retry_after_ms`` + ``queue_depth``).
+        ``tenant``/``priority`` bill the request to a tenant class
+        (ISSUE 11)."""
         trace_id = self._mint_trace_id()
         now = time.monotonic()
         t0_us = obs.now_us()
+        t_submit = time.monotonic()
         prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
         temperature = float(temperature)
         if temperature > 0.0 and rng is None:
@@ -437,24 +444,21 @@ class DisaggRouter(RouterBase):
         live = [w for w in self.prefill_workers if not w.dead]
         loads = [w.load() for w in live]
         fleet_depth = sum(ld["queue_depth"] for ld in loads)
+        fleet_cap = sum(ld["queue_capacity"] for ld in loads)
         if not live:
             self._reject(
                 "worker_lost", trace_id,
                 f"all {len(self.prefill_workers)} prefill workers are "
-                f"dead", retry_after_ms=1.0, queue_depth=0)
-        if self.slo is not None and fleet_depth > 0:
-            burns = [self.slo.burn_rate(m, self.slo.windows_s[0])
-                     for m in ("ttft", "throughput")]
-            burning = [b for b in burns if b is not None
-                       and b > self.shed_burn_threshold]
-            if burning:
-                self._reject(
-                    "shed_slo", trace_id,
-                    f"short-window burn rate {max(burning):.2f}x exceeds "
-                    f"shed threshold {self.shed_burn_threshold}x with "
-                    f"{fleet_depth} queued",
-                    retry_after_ms=self._retry_after_ms(),
-                    queue_depth=fleet_depth)
+                f"dead", retry_after_ms=1.0, queue_depth=0,
+                tenant=tenant)
+        # tenant plane, then the shared SLO-burn gate (best-effort at
+        # the base threshold, paid with paid_burn_headroom× more room)
+        tenant, max_new_tokens, capped = self._admit_tenant(
+            trace_id, tenant, priority, max_new_tokens,
+            queue_depth=fleet_depth, queue_capacity=fleet_cap,
+            retry_after_ms=self._retry_after_ms)
+        self._maybe_shed_slo(trace_id, fleet_depth,
+                             self._retry_after_ms, tenant)
         if deadline_s is not None:
             # feasibility against the DECODE side: the generation must
             # fit behind the least-loaded decode worker's backlog
@@ -465,7 +469,7 @@ class DisaggRouter(RouterBase):
                     "no decode worker can start before the request "
                     f"deadline (deadline_s={deadline_s})",
                     retry_after_ms=self._retry_after_ms(),
-                    queue_depth=fleet_depth)
+                    queue_depth=fleet_depth, tenant=tenant)
 
         candidates = [
             (ld["backlog_tokens"], ld["queue_depth"],
@@ -477,15 +481,20 @@ class DisaggRouter(RouterBase):
                 "queue_full", trace_id,
                 f"all {len(live)} live prefill-worker queues at capacity",
                 retry_after_ms=self._retry_after_ms(),
-                queue_depth=fleet_depth)
+                queue_depth=fleet_depth, tenant=tenant)
         _, _, _, pw = min(candidates)
         self._rr = (self._rr + 1) % max(len(live), 1)
 
+        if self.tenancy is not None and tenant is not None:
+            # per-tenant TTFT/goodput attribution rides the stream (the
+            # decode worker's engine owns it after the transfer hop)
+            on_token = self.tenancy.wrap_on_token(tenant, t_submit,
+                                                  on_token)
         req = Request(prompt, max_new_tokens, eos_id=eos_id,
                       deadline_t=(now + deadline_s
                                   if deadline_s is not None else None),
                       on_token=on_token, trace_id=trace_id,
-                      temperature=temperature, rng=key)
+                      temperature=temperature, rng=key, tenant=tenant)
         req.trace_us = {"submitted": obs.now_us()}
         obs.async_event("b", "request", trace_id, cat="serving_request",
                         request=req.id, prompt_len=req.prompt_len)
@@ -497,7 +506,10 @@ class DisaggRouter(RouterBase):
                             admission_reason=e.reason)
             self._reject(e.reason, trace_id, str(e),
                          retry_after_ms=self._retry_after_ms(),
-                         queue_depth=fleet_depth)
+                         queue_depth=fleet_depth, tenant=tenant)
+        if self.tenancy is not None and tenant is not None:
+            self.tenancy.on_admit(self.tenancy.resolve(tenant), req,
+                                  capped=capped)
         with self._lock:
             self._dispatched += 1
             self._dispatched_by[pw.name] += 1
@@ -519,10 +531,16 @@ class DisaggRouter(RouterBase):
             self.default_token_latency_ms))
 
     def _retry_after_ms(self) -> float:
-        """Back-off hint: the least-loaded decode worker's estimated
-        time to free one slot."""
-        est = min(self._est_wait_ms(dw) for dw in self.decode_workers)
-        return max(est, 1.0)
+        """Drain-aware back-off hint (ISSUE 11): the least-loaded
+        decode worker's queued tokens priced at the fleet's MEASURED
+        recent tokens/s (clamped + jittered in
+        ``derive_retry_after_ms``; zero-throughput edges fall back to
+        ``default_token_latency_ms``)."""
+        backlog = min(dw.load()["backlog_tokens"]
+                      for dw in self.decode_workers)
+        tokens_total = sum(dw.engine._tokens_emitted
+                           for dw in self.decode_workers)
+        return self._derive_retry_ms(backlog, tokens_total)
 
     # ---- the transfer hop (slabs → decode workers) ----
     def decode_free_slots(self) -> int:
@@ -728,11 +746,16 @@ class DisaggRouter(RouterBase):
         carries, attached to the handle (``shed_payload``), streamed as
         a ``disagg_shed`` JSONL record, and counted under
         ``worker_lost``."""
+        if self.tenancy is not None:
+            self.tenancy.count_shed(req.tenant, "worker_lost")
         shed = AdmissionError(
             "worker_lost", detail,
             retry_after_ms=self._retry_after_ms(),
             queue_depth=sum(w.scheduler.queue_depth
-                            for w in self.prefill_workers))
+                            for w in self.prefill_workers),
+            tenant=req.tenant,
+            rung=(None if self.tenancy is None
+                  else self.tenancy.ladder.rung))
         with self._lock:
             self._rejected["worker_lost"] = \
                 self._rejected.get("worker_lost", 0) + 1
@@ -999,6 +1022,8 @@ class DisaggRouter(RouterBase):
             out[f"disagg/{pw.name}/queue_depth"] = float(
                 pw.scheduler.queue_depth)
             out.update(pw.goodput.gauges(f"disagg/{pw.name}/goodput"))
+        if self.tenancy is not None:
+            out.update(self.tenancy.metrics())
         return out
 
     def requests_table(self) -> Dict[str, Any]:
@@ -1037,6 +1062,8 @@ class DisaggRouter(RouterBase):
         state["plane"] = self.plane.stats()
         if self.slo is not None:
             state["slo"] = self.slo.status()
+        if self.tenancy is not None:
+            state["tenancy"] = self.tenancy.state()
         return state
 
     def finalize_metrics(self) -> None:

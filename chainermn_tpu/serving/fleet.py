@@ -169,6 +169,9 @@ class FleetRouter(RouterBase):
                  max_failover_attempts: int = 2,
                  default_token_latency_ms: float = 20.0,
                  slo: Optional[SLOTracker] = None,
+                 shed_burn_threshold: float = 1.0,
+                 tenancy=None,
+                 paid_burn_headroom: float = 2.0,
                  metrics_writer=None,
                  bundle_dir: Optional[str] = None,
                  lane_config=None,
@@ -178,7 +181,11 @@ class FleetRouter(RouterBase):
         names = [w.name for w in workers]
         if len(set(names)) != len(names):
             raise ValueError(f"worker names must be unique: {names}")
-        super().__init__(metrics_writer=metrics_writer)
+        super().__init__(
+            metrics_writer=metrics_writer, tenancy=tenancy, slo=slo,
+            shed_burn_threshold=shed_burn_threshold,
+            paid_burn_headroom=paid_burn_headroom,
+            default_token_latency_ms=default_token_latency_ms)
         self.workers: Dict[str, WorkerClient] = {w.name: w
                                                 for w in workers}
         self.store = store
@@ -188,9 +195,11 @@ class FleetRouter(RouterBase):
             if lease_window_s is None else float(lease_window_s))
         self.start_grace_s = float(start_grace_s)
         self.max_failover_attempts = int(max_failover_attempts)
-        self.default_token_latency_ms = float(default_token_latency_ms)
-        self.slo = slo
         self.bundle_dir = bundle_dir
+        #: attached by serving.autoscale.FleetAutoscaler (ISSUE 11);
+        #: step() then drives its control loop and the fleet_health
+        #: provider carries its target-size/last-decision view
+        self.autoscaler = None
         self.lane_config = lane_config
         self.fence = EpochFence()
         # the health.py read face: schema-checks every lease payload
@@ -232,29 +241,37 @@ class FleetRouter(RouterBase):
         return "prefill" if "engine" not in roles else "engine"
 
     def _live(self, role: Optional[str] = None) -> List[WorkerClient]:
-        return [w for w in self.workers.values()
+        # snapshot: the autoscaler's add_worker mutates the dict on the
+        # router thread while submit threads iterate here
+        return [w for w in list(self.workers.values())
                 if w.state in ("starting", "live")
                 and (role is None or w.role == role)]
 
-    def _est_wait_ms(self, wc: WorkerClient) -> float:
-        lease = wc.last_lease or {}
-        backlog = int(lease.get("backlog_tokens", 0))
-        return max(float(backlog) * self.default_token_latency_ms, 1.0)
-
     def _retry_after_ms(self) -> float:
+        """Drain-aware back-off hint (ISSUE 11): the least-loaded live
+        worker's queued tokens priced at the fleet's MEASURED recent
+        tokens/s (clamped + jittered in ``derive_retry_after_ms``)."""
         live = self._live()
         if not live:
             return 1.0
-        return min(self._est_wait_ms(w) for w in live)
+        backlog = min(
+            int((w.last_lease or {}).get("backlog_tokens", 0))
+            for w in live)
+        with self._lock:
+            tokens = self._tokens
+        return self._derive_retry_ms(backlog, tokens)
 
     def submit(self, prompt, max_new_tokens: int, *,
                eos_id: Optional[int] = None,
                deadline_s: Optional[float] = None,
                on_token=None, temperature: float = 0.0,
-               rng=None) -> RequestHandle:
+               rng=None, tenant: Optional[str] = None,
+               priority: Optional[str] = None) -> RequestHandle:
         """Dispatch to the least-loaded live worker over its lane, or
         raise :class:`AdmissionError` with the uniform machine-readable
-        payload."""
+        payload.  ``tenant``/``priority`` bill the request to a tenant
+        class (ISSUE 11): budgets, ladder clamping, and paid-first SLO
+        protection key off them."""
         import numpy as np
 
         trace_id = self._mint_trace_id()
@@ -279,21 +296,33 @@ class FleetRouter(RouterBase):
                 f"({len(self.workers)} registered)",
                 retry_after_ms=1.0, queue_depth=0)
         depth_of = {}
+        fleet_cap = 0
         for w in live:
             lease = w.last_lease or {}
             depth_of[w.name] = (int(lease.get("queue_depth", 0))
                                 + w.sent_since_lease)
+            fleet_cap += int(lease.get("queue_capacity", 0))
         candidates = [
             w for w in live
             if depth_of[w.name] < int((w.last_lease or {}).get(
                 "queue_capacity", 1 << 30))]
         fleet_depth = sum(depth_of.values())
+        # tenant plane + the shared SLO-burn gate (ISSUE 11): budgets
+        # and the pause rung refuse best-effort work with tenant+rung
+        # attribution; the burn gate sheds best-effort at the base
+        # threshold and paid only with paid_burn_headroom× more room
+        tenant, max_new_tokens, capped = self._admit_tenant(
+            trace_id, tenant, priority, max_new_tokens,
+            queue_depth=fleet_depth, queue_capacity=fleet_cap,
+            retry_after_ms=self._retry_after_ms)
+        self._maybe_shed_slo(trace_id, fleet_depth,
+                             self._retry_after_ms, tenant)
         if not candidates:
             self._reject(
                 "queue_full", trace_id,
                 f"all {len(live)} live {role}-worker queues at capacity",
                 retry_after_ms=self._retry_after_ms(),
-                queue_depth=fleet_depth)
+                queue_depth=fleet_depth, tenant=tenant)
         order = sorted(
             range(len(candidates)),
             key=lambda i: (depth_of[candidates[i].name],
@@ -308,7 +337,7 @@ class FleetRouter(RouterBase):
                       deadline_t=(now + deadline_s
                                   if deadline_s is not None else None),
                       on_token=on_token, trace_id=trace_id,
-                      temperature=temperature, rng=key)
+                      temperature=temperature, rng=key, tenant=tenant)
         req.status = "running"   # mirror: the worker owns queueing
         req.timestamps["submitted"] = now
         entry = {"req": req, "worker": wc.name, "attempts": 1}
@@ -328,7 +357,7 @@ class FleetRouter(RouterBase):
             self._reject(
                 "worker_lost", trace_id,
                 f"fleet router thread died: {dead}",
-                retry_after_ms=1.0, queue_depth=0)
+                retry_after_ms=1.0, queue_depth=0, tenant=tenant)
         try:
             self._send_submit(wc, req)
         except Exception as e:  # noqa: BLE001 — no half-registered state
@@ -352,17 +381,26 @@ class FleetRouter(RouterBase):
             if not owned:
                 _flight.note("fleet", event="submit_send_superseded",
                              trace_id=trace_id, error=str(e))
+                if self.tenancy is not None and tenant is not None:
+                    self.tenancy.on_admit(self.tenancy.resolve(tenant),
+                                          req, capped=capped)
                 return RequestHandle(req)
             if isinstance(e, DcnLaneError):
                 # the uniform machine-readable rejection instead of a
                 # raw lane fault: the caller can submit_with_retry it
+                # (tenant attribution rides like every other reject)
                 self._reject(
                     "worker_lost", trace_id,
                     f"control-lane send to worker {wc.name} failed "
                     f"permanently: {e}",
                     retry_after_ms=self._retry_after_ms(),
-                    queue_depth=fleet_depth)
+                    queue_depth=fleet_depth, tenant=tenant)
             raise
+        # tracked only once the send stuck (a rejected submit must not
+        # occupy the tenant's inflight budget with a phantom forever)
+        if self.tenancy is not None and tenant is not None:
+            self.tenancy.on_admit(self.tenancy.resolve(tenant), req,
+                                  capped=capped)
         obs.async_event("b", "request", trace_id, cat="serving_request",
                         request=req.id, prompt_len=req.prompt_len)
         _flight.note("fleet", event="dispatched", trace_id=trace_id,
@@ -384,6 +422,7 @@ class FleetRouter(RouterBase):
             "rng": (None if req.rng is None
                     else [int(x) for x in np.asarray(req.rng)
                           .reshape(2)]),
+            "tenant": req.tenant,
         }
 
     def _send_submit(self, wc: WorkerClient, req: Request) -> None:
@@ -460,6 +499,8 @@ class FleetRouter(RouterBase):
                     self._failover_ttft_ms.add(ttft)
             if self.slo is not None:
                 self.slo.observe_ttft(ttft)
+            if self.tenancy is not None:
+                self.tenancy.on_ttft(req.tenant, ttft)
         with self._lock:
             self._tokens += 1
         if req.on_token is not None:
@@ -480,6 +521,10 @@ class FleetRouter(RouterBase):
         if req.tokens and "first_token" not in req.timestamps:
             req.timestamps["first_token"] = now
         req.finish(msg.get("finish_reason") or "max_tokens", now)
+        if self.tenancy is not None:
+            # the authoritative token list bills the tenant (streamed
+            # token messages are latency hints that may trail it)
+            self.tenancy.on_tokens(req.tenant, len(req.tokens))
         with self._lock:
             self._inflight.pop(trace_id, None)
             self._results += 1
@@ -795,12 +840,17 @@ class FleetRouter(RouterBase):
             self._rejected["worker_lost"] = \
                 self._rejected.get("worker_lost", 0) + 1
             self._shed_inflight += 1
+        if self.tenancy is not None:
+            self.tenancy.count_shed(req.tenant, "worker_lost")
         shed = AdmissionError(
             "worker_lost", why,
             retry_after_ms=self._retry_after_ms(),
             queue_depth=sum(
                 int((w.last_lease or {}).get("queue_depth", 0))
-                for w in self._live()))
+                for w in self._live()),
+            tenant=req.tenant,
+            rung=(None if self.tenancy is None
+                  else self.tenancy.ladder.rung))
         req.shed_payload = shed.to_dict()
         req.finish("shed", time.monotonic())
         self._gc_slab(f"slab/{req.trace_id}")
@@ -866,10 +916,14 @@ class FleetRouter(RouterBase):
     # driving
     # ------------------------------------------------------------------
     def step(self) -> int:
-        """One router round: pump worker messages, then the supervisor
-        tick."""
+        """One router round: pump worker messages, the supervisor
+        tick, then the autoscaler's control loop when one is attached
+        (ISSUE 11) — the router's driver thread IS the supervisor
+        thread the autoscale policy runs on."""
         handled = self.pump()
         self.supervisor_tick()
+        if self.autoscaler is not None:
+            self.autoscaler.maybe_tick()
         return handled
 
     def start(self, poll_s: float = 0.002) -> None:
@@ -1009,6 +1063,10 @@ class FleetRouter(RouterBase):
             out["fleet/detection_ms"] = round(
                 self.last_detection["lease_age_s"] * 1e3, 3)
         out.update(self.goodput.gauges("fleet/goodput"))
+        if self.tenancy is not None:
+            out.update(self.tenancy.metrics())
+        if self.autoscaler is not None:
+            out.update(self.autoscaler.metrics())
         return out
 
     def reset_stats(self) -> None:
@@ -1054,6 +1112,14 @@ class FleetRouter(RouterBase):
         state["lease_window_s"] = self.lease_window_s
         state["fenced_refusals"] = self.fence.refusal_counts()
         state["last_detection"] = self.last_detection
+        # the autoscaler's view (ISSUE 11 satellite): live /statusz and
+        # the flight bundle agree on WHY the fleet is its current size
+        # — target per role, last decision + reason, and every tenant's
+        # budget consumption
+        if self.autoscaler is not None:
+            state["autoscale"] = self.autoscaler.state()
+        if self.tenancy is not None:
+            state["tenancy"] = self.tenancy.state()
         state["workers"] = {
             w.name: {
                 "role": w.role,

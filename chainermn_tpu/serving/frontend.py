@@ -139,6 +139,8 @@ def _request_row(req: Request) -> Dict[str, Any]:
         "n_tokens": len(req.tokens),
         "timestamps": {k: round(v, 6) for k, v in ts.items()},
     }
+    if req.tenant is not None:
+        row["tenant"] = req.tenant
     if "submitted" in ts and "first_token" in ts:
         row["ttft_ms"] = round(
             (ts["first_token"] - ts["submitted"]) * 1e3, 3)
@@ -248,7 +250,8 @@ class ServingEngine:
                on_token: Optional[Callable[[int, int], None]] = None,
                trace_id: Optional[str] = None,
                temperature: float = 0.0,
-               rng=None) -> RequestHandle:
+               rng=None,
+               tenant: Optional[str] = None) -> RequestHandle:
         """Enqueue a generation request; raises :class:`AdmissionError`
         (with ``.reason``) when the queue is full or it can never fit.
         ``on_token(token, request_id)`` streams each token from the
@@ -259,7 +262,10 @@ class ServingEngine:
         samples this request's tokens through the shared tick and
         REQUIRES an explicit ``rng`` key (the ``lm_generate`` contract:
         a silent default key would draw identical sequences every
-        call); greedy requests omit both."""
+        call); greedy requests omit both.  ``tenant`` stamps the
+        request's billing identity (ISSUE 11) — budgets and priority
+        live at the ROUTER's tenant plane; the engine only carries the
+        attribution into /requestz rows and shed payloads."""
         now = time.monotonic()
         prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
         temperature = float(temperature)
@@ -275,7 +281,7 @@ class ServingEngine:
                       deadline_t=(now + deadline_s
                                   if deadline_s is not None else None),
                       on_token=on_token, trace_id=trace_id,
-                      temperature=temperature, rng=key)
+                      temperature=temperature, rng=key, tenant=tenant)
         # tracer-clock stamp + flow BEGIN before the request becomes
         # visible to the scheduler: with start()'s driver thread, a
         # request can be admitted (even finished) the instant submit()

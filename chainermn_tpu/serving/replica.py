@@ -84,11 +84,12 @@ class Replica:
                deadline_s: Optional[float] = None,
                on_token=None,
                trace_id: Optional[str] = None,
-               temperature: float = 0.0, rng=None) -> RequestHandle:
+               temperature: float = 0.0, rng=None,
+               tenant: Optional[str] = None) -> RequestHandle:
         return self.engine.submit(
             prompt, max_new_tokens, eos_id=eos_id, deadline_s=deadline_s,
             on_token=on_token, trace_id=trace_id, temperature=temperature,
-            rng=rng)
+            rng=rng, tenant=tenant)
 
     def step(self):
         return self.engine.step()

@@ -48,17 +48,29 @@ class AdmissionError(Exception):
     layer can estimate them (the router always fills both) so a client
     can back off intelligently instead of hammering; ``to_dict()`` is
     the wire shape the serving JSONL stream and HTTP 429 bodies carry.
+
+    ``tenant`` / ``rung`` (ISSUE 11): a multi-tenant rejection names
+    WHO was shed and at which degradation-ladder rung — reason
+    ``shed_tenant_budget`` (per-tenant admission budget exhausted, or
+    best-effort admission paused at the top rung) carries both, and
+    ``shed_slo``/``queue_full`` carry tenant attribution whenever the
+    submit was tagged.  Absent for untagged traffic, so pre-tenancy
+    wire consumers see exactly the old shape.
     """
 
     def __init__(self, reason: str, detail: str = "", *,
                  retry_after_ms: Optional[float] = None,
-                 queue_depth: Optional[int] = None):
+                 queue_depth: Optional[int] = None,
+                 tenant: Optional[str] = None,
+                 rung: Optional[int] = None):
         self.reason = reason
         self.detail = detail
         self.retry_after_ms = (None if retry_after_ms is None
                                else float(retry_after_ms))
         self.queue_depth = (None if queue_depth is None
                             else int(queue_depth))
+        self.tenant = None if tenant is None else str(tenant)
+        self.rung = None if rung is None else int(rung)
         super().__init__(f"{reason}: {detail}" if detail else reason)
 
     def to_dict(self) -> dict:
@@ -67,6 +79,10 @@ class AdmissionError(Exception):
             out["retry_after_ms"] = round(self.retry_after_ms, 3)
         if self.queue_depth is not None:
             out["queue_depth"] = self.queue_depth
+        if self.tenant is not None:
+            out["tenant"] = self.tenant
+        if self.rung is not None:
+            out["rung"] = self.rung
         return out
 
 
@@ -102,7 +118,8 @@ class Request:
                  on_token: Optional[Callable] = None,
                  trace_id: Optional[str] = None,
                  temperature: float = 0.0,
-                 rng=None):
+                 rng=None,
+                 tenant: Optional[str] = None):
         self.id = next(Request._ids)
         # pid disambiguates across engine restarts on one box; the
         # counter disambiguates within the process
@@ -121,6 +138,10 @@ class Request:
         # tokens the fused engine would.
         self.temperature = float(temperature)
         self.rng = rng
+        # multi-tenant QoS (ISSUE 11): the tenant this request bills to
+        # (None = untagged).  Rides the fleet wire so worker-side
+        # /requestz rows and shed payloads keep the attribution.
+        self.tenant = None if tenant is None else str(tenant)
         self.tokens: List[int] = []       # generated tokens, in order
         self.status = "queued"            # queued|running|done|evicted
         self.finish_reason: Optional[str] = None

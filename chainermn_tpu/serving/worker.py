@@ -80,7 +80,8 @@ def request_from_wire(wire: Dict[str, Any], *, on_token=None) -> Request:
         trace_id=wire["trace_id"],
         temperature=float(wire.get("temperature", 0.0)),
         rng=(None if rng is None
-             else np.asarray(rng, np.uint32).reshape(2)))
+             else np.asarray(rng, np.uint32).reshape(2)),
+        tenant=wire.get("tenant"))
     # a decode-installed request never passes Scheduler.submit (the
     # only other place this is stamped) — TTFT/emit paths need it
     req.timestamps["submitted"] = time.monotonic()
@@ -222,7 +223,8 @@ class WorkerRuntime:
                     on_token=self._on_token(trace_id),
                     trace_id=trace_id,
                     temperature=float(wire.get("temperature", 0.0)),
-                    rng=wire.get("rng"))
+                    rng=wire.get("rng"),
+                    tenant=wire.get("tenant"))
             except AdmissionError as e:
                 self._send("shed", trace_id=trace_id, payload=e.to_dict())
                 return
@@ -372,6 +374,10 @@ class WorkerRuntime:
             running = list(eng._running.values())
         backlog += sum(max(r.max_new_tokens - len(r.tokens), 0)
                        for r in running)
+        # decode tick-gap p99 rides the lease (ISSUE 11): the
+        # autoscaler's decode-side pressure signal, measured where it
+        # exists (the engine) and read where the policy runs
+        gap_p99 = eng._tick_gap_ms.percentile(99)
         return {
             "queue_depth": len(queued),
             "queue_capacity": eng.scheduler.queue_capacity,
@@ -383,6 +389,8 @@ class WorkerRuntime:
             "in_flight": len(self._local),
             "draining": self.draining,
             "last_step_age_s": round(step_age, 4),
+            "tick_gap_p99_ms": (None if gap_p99 is None
+                                else round(gap_p99, 3)),
         }
 
     def start_heartbeat(self) -> None:

@@ -73,12 +73,17 @@ _SKIP = re.compile(
 #: detection/failover/fenced/redispatch: the serving_chaos section's
 #: death-detection latency, failover TTFT penalty, zombie-fencing
 #: refusal and re-dispatch tallies — more of each means the fault
-#: story got slower or louder, ISSUE 10).
+#: story got slower or louder, ISSUE 10;
+#: flap/ttft/rung/degraded: the serving_autoscale section's keys —
+#: a flap is an up-then-down inside one cooldown window (must stay 0),
+#: ttft is the priority tenant's held latency, and rung/degraded count
+#: how far down the overload ladder best-effort service was walked —
+#: more of any means the control loop got worse, ISSUE 11).
 _LOWER = re.compile(
     r"(time|_ms|ms_|/ms$|^ms$|latency|seconds|_s$|/s$|bytes|loss|"
     r"step_ms|gap|slowdown|imbalance|drift|anomal|dropped|findings|"
     r"rejected|shed|steps_to_recover|variance|requeue|detection|"
-    r"failover|fenced|redispatch)",
+    r"failover|fenced|redispatch|flap|ttft|rung|degraded)",
     re.IGNORECASE)
 
 

@@ -172,6 +172,58 @@ def explain(bundle: dict) -> dict:
             "redispatched": fleet.get("redispatched"),
             "shed_inflight": fleet.get("shed_inflight"),
         }
+        if isinstance(fleet.get("autoscale"), dict):
+            out["autoscale_at_death"] = fleet["autoscale"]
+    # autoscaling + overload-degradation evidence (ISSUE 11): the ring's
+    # machine-readable autoscale_decision / degrade events answer "why
+    # did the fleet resize" and "who got shed, at which rung" — and any
+    # provider that carried a tenancy block names per-tenant shed counts
+    decisions = [ev for ev in bundle.get("flight", [])
+                 if ev.get("kind") == "autoscale_decision"]
+    rungs = [ev for ev in bundle.get("flight", [])
+             if ev.get("kind") == "degrade"]
+    tenancy = None
+    for prov in providers.values():
+        if isinstance(prov, dict) and isinstance(prov.get("tenancy"),
+                                                 dict):
+            tenancy = prov["tenancy"]
+    if isinstance((man.get("extra") or {}).get("tenancy"), dict):
+        tenancy = man["extra"]["tenancy"]
+    if decisions:
+        out["autoscale"] = {
+            "decisions": len(decisions),
+            "ups": sum(1 for d in decisions
+                       if d.get("direction") == "up"),
+            "downs": sum(1 for d in decisions
+                         if d.get("direction") == "down"),
+            "last": {k: decisions[-1].get(k)
+                     for k in ("role", "direction", "before", "target",
+                               "reason", "signal", "threshold",
+                               "spawned", "drained")
+                     if decisions[-1].get(k) is not None},
+            "recent": [
+                {k: d.get(k) for k in ("role", "direction", "before",
+                                       "target", "reason")}
+                for d in decisions[-5:]],
+        }
+    if rungs:
+        out["degradation"] = {
+            "transitions": len(rungs),
+            "max_rung": max(int(ev.get("rung", 0)) for ev in rungs),
+            "last": {k: rungs[-1].get(k)
+                     for k in ("rung", "name", "from_rung", "pressure")},
+        }
+    if tenancy is not None:
+        out["tenants"] = {
+            name: {"priority": t.get("priority"),
+                   "shed": t.get("shed"),
+                   "degraded": t.get("degraded"),
+                   "admitted": t.get("admitted"),
+                   "inflight": t.get("inflight")}
+            for name, t in (tenancy.get("tenants") or {}).items()}
+        if isinstance(tenancy.get("ladder"), dict):
+            out.setdefault("degradation", {})["ladder"] = \
+                tenancy["ladder"]
     # preemption bundles (ISSUE 8): the scheduler took the node, not a
     # bug — surface the grace accounting and the elastic resume hint
     pre = (man.get("extra") or {}).get("preempt")
@@ -247,6 +299,43 @@ def render_text(rep: dict) -> str:
         if fl.get("fenced_refusals"):
             lines.append(
                 f"    fenced refusals: {json.dumps(fl['fenced_refusals'])}")
+    if rep.get("autoscale"):
+        a = rep["autoscale"]
+        last = a.get("last") or {}
+        lines.append(
+            f"  autoscale: {a.get('decisions')} decision(s) "
+            f"({a.get('ups')} up / {a.get('downs')} down)")
+        if last:
+            lines.append(
+                f"    last: {last.get('direction')} {last.get('role')} "
+                f"{last.get('before')} -> {last.get('target')} "
+                f"(signal {last.get('reason')}={last.get('signal')} vs "
+                f"threshold {last.get('threshold')})"
+                + (f", drained {last['drained']}"
+                   if last.get("drained") else "")
+                + (f", spawned {last['spawned']}"
+                   if last.get("spawned") else ""))
+    if rep.get("autoscale_at_death"):
+        a = rep["autoscale_at_death"]
+        lines.append(
+            f"  autoscaler at death: targets {a.get('target_sizes')} "
+            f"(spawn failures {a.get('spawn_failures')}, drains "
+            f"requested {a.get('drains_requested')})")
+    if rep.get("degradation"):
+        dg = rep["degradation"]
+        last = dg.get("last") or {}
+        lines.append(
+            f"  degradation ladder: max rung {dg.get('max_rung')} over "
+            f"{dg.get('transitions')} transition(s); last "
+            f"{last.get('from_rung')} -> {last.get('rung')} "
+            f"({last.get('name')}) at pressure {last.get('pressure')}")
+    if rep.get("tenants"):
+        lines.append("  per-tenant overload outcome:")
+        for name, t in sorted(rep["tenants"].items()):
+            lines.append(
+                f"    {name} ({t.get('priority')}): admitted "
+                f"{t.get('admitted')}, degraded {t.get('degraded')}, "
+                f"shed {json.dumps(t.get('shed') or {})}")
     if rep.get("preempt"):
         pre = rep["preempt"]
         used = pre.get("grace_used_s")

@@ -201,6 +201,98 @@ def test_sigkill_zombie_and_drain_against_real_processes(devices,
 
 
 @pytest.mark.slow
+def test_autoscale_real_process_scale_down_is_drain(devices, tmp_path):
+    """ISSUE 11 chaos acceptance against REAL worker processes: a
+    burst drives the autoscaler to SPAWN a worker process; the idle
+    tail drives a scale-down that is a DRAIN — the victim process
+    finishes in-flight work, sheds NOTHING (``drain_shed == 0``
+    asserted from the fleet counters), and its exit payload is code
+    0.  Every decision is a machine-readable ``autoscale_decision``."""
+    import jax
+
+    from chainermn_tpu.parallel import init_tp_transformer_lm
+    from chainermn_tpu.serving.autoscale import (AutoscalePolicy,
+                                                 FleetAutoscaler,
+                                                 proc_spawn_factory)
+    from chainermn_tpu.serving.fleet import build_proc_fleet
+
+    params = init_tp_transformer_lm(
+        jax.random.PRNGKey(0), VOCAB, D, HEADS, LAYERS, max_len=64,
+        pos_impl="rope")
+    lane_dir = str(tmp_path / "lanes")
+    router = build_proc_fleet(
+        params, {"engine": 1}, lane_dir,
+        head_dim=HEAD_DIM, beat_interval_s=0.05, miss_beats=4,
+        bundle_dir=str(tmp_path / "bundles"), env=_worker_env(),
+        worker_kwargs=dict(n_slots=2, max_total=24, queue_capacity=16))
+    autoscaler = FleetAutoscaler(
+        router,
+        proc_spawn_factory(
+            lane_dir, os.path.join(lane_dir, "fleet_params.pkl"),
+            beat_interval_s=0.05, env=_worker_env()),
+        policies=[AutoscalePolicy(
+            role="engine", min_workers=1, max_workers=2,
+            up_backlog_tokens_per_worker=24.0,
+            down_backlog_tokens_per_worker=4.0,
+            up_queue_depth_per_worker=2.0,
+            down_queue_depth_per_worker=0.5,
+            up_cooldown_s=0.5, down_cooldown_s=1.0,
+            down_stable_s=1.0)],
+        interval_s=0.1)
+    policy = autoscaler.policies["engine"]
+    try:
+        _pump_until(router,
+                    lambda: all(w.state == "live"
+                                for w in router.workers.values()),
+                    timeout=120, what="worker boot lease")
+        rng = np.random.RandomState(0)
+        prompts = [rng.randint(0, VOCAB, 5).astype(np.int32)
+                   for _ in range(8)]
+        handles = [router.submit(p, 8) for p in prompts]
+        _pump_until(router,
+                    lambda: any(d["direction"] == "up"
+                                and d.get("spawned")
+                                for d in policy.decisions),
+                    timeout=60, what="burst-driven scale-up")
+        up = next(d for d in policy.decisions
+                  if d["direction"] == "up" and d.get("spawned"))
+        spawned = up["spawned"][0]
+        assert router.workers[spawned].proc is not None, \
+            "scale-up must spawn a real process"
+        _pump_until(router,
+                    lambda: all(h.status in ("done", "evicted")
+                                for h in handles),
+                    timeout=180, what="burst drain")
+        assert all(h.status == "done" for h in handles)
+        # idle tail: scale-down must be a drain, never a kill
+        _pump_until(router,
+                    lambda: any(d["direction"] == "down"
+                                and d.get("drained")
+                                for d in policy.decisions),
+                    timeout=60, what="idle-tail scale-down")
+        down = next(d for d in policy.decisions
+                    if d["direction"] == "down" and d.get("drained"))
+        victim = down["drained"][0]
+        _pump_until(router,
+                    lambda: router.workers[victim].state == "drained",
+                    timeout=120, what="drain handshake")
+        # the worker EXIT PAYLOAD: a drained autoscale victim exits 0
+        rc = router.workers[victim].proc.wait(timeout=60)
+        assert rc == 0, f"drained worker exited {rc}, want 0"
+        m = router.metrics()
+        assert m.get("fleet/shed_inflight_total", 0) == 0   # drain_shed
+        assert m.get("fleet/rejected/worker_lost", 0) == 0
+        assert policy.flap_count() == 0
+        assert m["autoscale/engine/flap"] == 0
+    finally:
+        router.shutdown(timeout_s=60)
+        router.close()
+    for name, wc in router.workers.items():
+        if wc.proc is not None:
+            assert wc.proc.poll() is not None, f"{name} still running"
+
+
+@pytest.mark.slow
 def test_serving_chaos_bench_section_and_gate(tmp_path):
     """The ``serving_chaos`` bench section (ISSUE 10 satellite): runs
     on this backend, carries the detection/failover/shed/recovery
