@@ -1331,6 +1331,174 @@ def bench_serving_chaos():
     }
 
 
+def bench_serving_kv_economy():
+    """Fleet-global KV economy perf (ISSUE 12, docs/SERVING.md "Fleet
+    KV economy"): what the global prefix index + remote pulls + the
+    host-RAM spill tier actually buy, on the gate.
+
+    A 4-engine-worker fleet (in-process runtimes over the loopback
+    lanes — the REAL announce/index/pull/fencing code) under a
+    shared-prefix workload: per unique prefix, ONE leader prefills and
+    every follower lands on a different worker, whose miss resolves by
+    PULLING the slab over the transfer plane instead of re-prefilling.
+
+    * ``prefill_calls_per_unique_prefix`` — THE economy metric:
+      fleet-wide prefill calls per unique prefix (1.0 = perfect reuse;
+      the pre-ISSUE-12 fleet paid ~1 per REQUEST).  Acceptance bound:
+      ≈ 1.
+    * ``remote_pull_hit_rate`` — followers served by pull (the rest hit
+      a local copy a previous pull already installed).
+    * ``leader_ttft_p50_ms`` vs ``pulled_ttft_p50_ms`` — the
+      transfer-vs-re-prefill wall, measured end to end.
+    * ``stale_fallbacks`` / ``crc_refusals`` — the degrade paths (must
+      stay 0 on a healthy run; both gate lower-is-better).
+    * ``spill_restore_ms`` vs ``reprefill_ms`` — a 2-slot engine forced
+      to scavenge a hot prefix: eviction spills the slab to host RAM,
+      the next matching prompt restores it through the compiled inject
+      path (CRC verified) instead of re-prefilling.
+
+    Every-backend contract; ``prefill_calls``/``stale``/``spill``/
+    ``crc``/``*_ms`` keys gate lower-is-better in bench_history.jsonl.
+    """
+    import threading
+
+    import jax
+    import numpy as np
+
+    import chainermn_tpu as mn
+    from chainermn_tpu.parallel import init_tp_transformer_lm
+    from chainermn_tpu.serving.fleet import build_local_fleet
+
+    vocab, d_model, n_heads, n_layers = 128, 32, 4, 2
+    s_p, new = 24, 6
+    n_unique, fanout = 2, 4          # requests per unique prefix
+    params = init_tp_transformer_lm(
+        jax.random.PRNGKey(0), vocab, d_model, n_heads, n_layers,
+        max_len=s_p + new, pos_impl="rope")
+    mesh = mn.make_nd_mesh(("model",), (1,), jax.devices()[:1])
+    head_dim = d_model // n_heads
+    rs = np.random.RandomState(0)
+    uniques = [rs.randint(0, vocab, s_p).astype(np.int32)
+               for _ in range(n_unique)]
+    wk = dict(n_slots=4, max_total=s_p + new, queue_capacity=16,
+              mesh=mesh)
+
+    router, runtimes = build_local_fleet(
+        params, {"engine": 4}, head_dim=head_dim,
+        beat_interval_s=0.02, miss_beats=4, worker_kwargs=wk)
+    threads = [threading.Thread(target=rt.run, daemon=True)
+               for rt in runtimes]
+    for t in threads:
+        t.start()
+    router.start()
+
+    def wait_done(handles, timeout=120):
+        t0 = time.time()
+        while (any(h.status not in ("done", "evicted") for h in handles)
+               and time.time() - t0 < timeout):
+            time.sleep(0.003)
+        return [h for h in handles
+                if h.status not in ("done", "evicted")]
+
+    # warm every worker's prefill/tick compiles with DISTINCT prompts
+    # (same padded length, different content — no cross-hits)
+    warm = [router.submit(rs.randint(0, vocab, s_p).astype(np.int32), 2)
+            for _ in range(8)]
+    wait_done(warm)
+    # warm the PULL path too (each worker's inject program compiles on
+    # its first landing): one shared warm prefix, leader then fan-out
+    warm_shared = rs.randint(0, vocab, s_p).astype(np.int32)
+    wait_done([router.submit(warm_shared, 2)])
+    time.sleep(0.1)                      # announce lands in the index
+    wait_done([router.submit(warm_shared, 2) for _ in range(6)])
+    time.sleep(0.1)                      # leases carry warm counters
+    m0 = router.metrics()
+    prefills_before = m0.get("fleet/cache/prefill_calls", 0.0)
+    router.reset_stats()
+
+    # leaders: one prefill per unique prefix, donated + announced
+    leaders = [router.submit(p, new) for p in uniques]
+    wait_done(leaders)
+    time.sleep(0.1)                      # announces land in the index
+    # followers: identical prompts, least-loaded spread across the
+    # other workers — local misses resolved by remote pulls
+    followers = []
+    for p in uniques:
+        followers += [router.submit(p, new)
+                      for _ in range(fanout - 1)]
+    hung = wait_done(followers)
+    time.sleep(0.1)                      # final lease refresh
+    m = router.metrics()
+    router.stop()
+    for rt in runtimes:
+        rt.finished = True
+    for t in threads:
+        t.join(timeout=5)
+    router.close()
+
+    prefill_calls = m.get("fleet/cache/prefill_calls", 0.0) \
+        - prefills_before
+    leader_ttfts = sorted(h.ttft_ms for h in leaders
+                          if h.ttft_ms is not None)
+    pulled_ttfts = sorted(h.ttft_ms for h in followers
+                          if h.ttft_ms is not None)
+    mid = lambda xs: xs[len(xs) // 2] if xs else None  # noqa: E731
+
+    # --- spill tier: eviction -> host RAM -> restore ------------------
+    from chainermn_tpu.serving import ServingEngine
+    eng = ServingEngine(params, head_dim=head_dim, n_slots=2,
+                        max_total=s_p + new, mesh=mesh)
+    hot = uniques[0]
+
+    def run_one(prompt):
+        t0 = time.time()
+        h = eng.submit(prompt, new)
+        eng.run()
+        return h, (time.time() - t0) * 1e3
+
+    run_one(rs.randint(0, vocab, s_p).astype(np.int32))   # warm compiles
+    _, reprefill_ms = run_one(hot)       # prefills + donates the slab
+    # churn: enough distinct donations to scavenge (and spill) `hot`
+    for _ in range(3):
+        run_one(rs.randint(0, vocab, s_p).astype(np.int32))
+    spills = eng.spill.spills
+    _, restore_ms = run_one(hot)         # spill hit -> compiled restore
+    sp = eng.spill.stats()
+    eng.close()
+
+    return {
+        "config": f"4 engine workers, d{d_model} L{n_layers} V{vocab} "
+                  f"prompt{s_p} new{new}, {n_unique} unique prefixes × "
+                  f"{fanout} requests, beat 20ms, loopback lanes; "
+                  f"spill: 2-slot engine, same model",
+        "requests_total": n_unique * fanout,
+        "unique_prefixes": n_unique,
+        "fleet_prefill_calls": int(prefill_calls),
+        "prefill_calls_per_unique_prefix": round(
+            prefill_calls / max(n_unique, 1), 3),
+        "remote_pulls": int(m.get("fleet/cache/remote_pulls", 0)),
+        "remote_pull_hit_rate": round(
+            m.get("fleet/cache/remote_pulls", 0.0)
+            / max(n_unique * (fanout - 1), 1), 4),
+        "index_entries": int(m.get("fleet/cache/index_entries", 0)),
+        "stale_fallbacks": int(m.get("fleet/cache/stale_fallbacks", 0)),
+        "crc_refusals": int(m.get("fleet/cache/crc_refusals", 0)),
+        "orphan_tags_swept": int(
+            m.get("fleet/cache/orphan_tags_swept", 0)),
+        "hung_requests": len(hung),
+        "leader_ttft_p50_ms": (round(mid(leader_ttfts), 2)
+                               if leader_ttfts else None),
+        "pulled_ttft_p50_ms": (round(mid(pulled_ttfts), 2)
+                               if pulled_ttfts else None),
+        "spills": int(sp["spills"]),
+        "restores": int(sp["restores"]),
+        "spilled_before_restore": int(spills),
+        "spill_store_bytes": int(sp["bytes"]),
+        "reprefill_ms": round(reprefill_ms, 2),
+        "spill_restore_ms": round(restore_ms, 2),
+    }
+
+
 def bench_elastic_resume():
     """Elastic/preemption robustness perf (ISSUE 8, docs/ROBUSTNESS.md):
     what fault tolerance actually costs, on the gate.
@@ -1970,6 +2138,7 @@ def main():
         "serving_disagg": None,
         "serving_chaos": None,
         "serving_autoscale": None,
+        "serving_kv_economy": None,
         "data_path": None,
         "long_context": None,
         "projected_scaling": projected,
@@ -2026,6 +2195,9 @@ def main():
             "autoscale_flap": g(result, "serving_autoscale", "flap"),
             "autoscale_gold_ttft_p99": g(result, "serving_autoscale",
                                          "gold_ttft_p99_ms"),
+            "kv_economy_prefills_per_prefix": g(
+                result, "serving_kv_economy",
+                "prefill_calls_per_unique_prefix"),
             "flash_s8192_mfu": g(result, "long_context",
                                  "flash_fwd_bwd_S8192", "attn_mfu"),
             "flash_s16384_mfu": g(result, "long_context",
@@ -2043,7 +2215,8 @@ def main():
         line = json.dumps(c)
         if len(line) > 1200:  # never let the compact line outgrow the tail
             for k in ("sections_complete", "data_assembly_ips",
-                      "flash_s16384_mfu"):
+                      "flash_s16384_mfu",
+                      "kv_economy_prefills_per_prefix"):
                 c.pop(k, None)
             line = json.dumps(c)
         return line
@@ -2215,6 +2388,23 @@ def main():
             emit()
     else:
         print("bench: over budget — serving_autoscale section skipped",
+              file=sys.stderr)
+
+    # --- serving KV economy: global index + pulls + spill tier (ISSUE 12) --
+    # Every-backend contract; prefill_calls/stale/spill/crc/*_ms keys gate
+    # lower-is-better in bench_history.jsonl — the acceptance bound is
+    # prefill_calls_per_unique_prefix ~= 1 (remote hits served by pull,
+    # not re-prefill).
+    if not over_budget():
+        try:
+            result["serving_kv_economy"] = bench_serving_kv_economy()
+            emit("serving_kv_economy")
+        except Exception as e:
+            print(f"bench: serving_kv_economy section failed: {e!r}",
+                  file=sys.stderr)
+            emit()
+    else:
+        print("bench: over budget — serving_kv_economy section skipped",
               file=sys.stderr)
 
     # --- elastic resume: checkpoint/reshard/preemption cost (ISSUE 8) ------

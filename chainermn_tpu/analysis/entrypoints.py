@@ -819,6 +819,243 @@ def _build_worker_lane() -> Dict[str, Any]:
             }}
 
 
+def _is_tracing(args) -> bool:
+    """True when ``args`` carry jax tracers (the probe is being traced
+    for the jaxpr engine, not called on concrete variant values)."""
+    import jax
+
+    return any(isinstance(x, jax.core.Tracer)
+               for x in jax.tree_util.tree_leaves(args))
+
+
+class _KvSpillProbe:
+    """Variant probe for the host-RAM spill tier (ISSUE 12): every call
+    runs one full spill round trip around the compiled inject program —
+    pack the source slot (CRC stamped), put/get through the bounded
+    host store, CRC-verified ``unpack_into`` restore into the restore
+    slot — and asserts the restore is BYTE-EXACT vs the packed rows
+    with its ledger booking equal to the ``transfer_cost`` statics.
+    The spill tier is host bookkeeping: one compiled program across
+    (slab, slot) variants, zero device-traffic growth."""
+
+    def __init__(self, jfn, pool, plane, spill, length):
+        self._jfn = jfn
+        self._pool = pool
+        self._plane = plane
+        self._spill = spill
+        self._length = int(length)
+
+    def __call__(self, *a):
+        import pickle
+
+        import jax
+        import numpy as np
+
+        from chainermn_tpu.observability import flight
+        from chainermn_tpu.serving.transfer import (SPILL_AXIS, SPILL_OP,
+                                                    transfer_cost)
+        if _is_tracing(a):
+            # under the jaxpr trace every jax op stages to tracers —
+            # the host round trip (device_get inside pack) cannot run;
+            # the trace captures the inject program, which is the
+            # device contract under analysis
+            return self._jfn(*a)
+        pool, L = self._pool, self._length
+        seq = tuple(range(L))
+        with _traced_obs_state():
+            payload = self._plane.pack(pool, 0, L,
+                                       meta={"seq": list(seq),
+                                             "length": L})
+            assert self._spill.put(seq, L, payload)
+            got = self._spill.get(seq)
+            stats = self._plane.unpack_into(
+                got, pool, 1, ledger_op=SPILL_OP,
+                ledger_axis=SPILL_AXIS)
+            want = transfer_cost(pool.n_layers, L, pool.kv_dim,
+                                 pool.caches[0][0].dtype, mode="lanes")
+            assert stats["ledger_bytes"] == want["ledger_bytes"], (
+                stats, want)
+            # byte-exact round trip: the restored rows ARE the packed
+            # rows (the ISSUE 12 acceptance, held here on every call)
+            rows = pickle.loads(payload)["rows"]
+            for (ks, vs), (kc, vc) in zip(rows, pool.caches):
+                np.testing.assert_array_equal(
+                    ks, np.asarray(jax.device_get(kc[1, :L])))
+                np.testing.assert_array_equal(
+                    vs, np.asarray(jax.device_get(vc[1, :L])))
+            out = self._jfn(*a)
+            flight.note("serving", event="restore", prefix_len=L)
+            flight.note("phase", name="serving/spill_restore")
+        return out
+
+    def _cache_size(self):
+        return self._jfn._cache_size()
+
+
+def _build_kv_spill() -> Dict[str, Any]:
+    """The host-RAM spill tier's device half (ISSUE 12): the SAME
+    pool-lifetime compiled inject program every lane transfer lands
+    through, here driven by the spill round trip (pack → bounded host
+    LRU store → CRC verify → restore).  Contract: one program across
+    (slab, dst slot) variants, byte-exact restores, ledger-reconciled
+    against ``transfer_cost`` statics — all asserted in-probe on every
+    call."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from chainermn_tpu.serving.cache_pool import CachePool
+    from chainermn_tpu.serving.spill import HostSpillStore
+    from chainermn_tpu.serving.transfer import KvTransferPlane
+
+    params, specs, mesh = _tiny_lm()
+    head_dim = 4
+    n_kv = 2  # _tiny_lm: 2 heads, no GQA
+    dtype = params["embed"].dtype
+    pool = CachePool(2, 8, 1, n_kv * head_dim, dtype, mesh, "model")
+    # give slot 0 real (random) K/V so the byte-exact check is honest
+    # (keep the pool's sharding — an unsharded replacement would make
+    # the first inject call compile a second program)
+    from jax.sharding import NamedSharding
+    sharding = NamedSharding(mesh, pool.cache_spec)
+    rng = np.random.RandomState(_SEED)
+    pool.caches = [
+        (jax.device_put(rng.randn(2, 8, n_kv * head_dim).astype(dtype),
+                        sharding),
+         jax.device_put(rng.randn(2, 8, n_kv * head_dim).astype(dtype),
+                        sharding))]
+    plane = KvTransferPlane()
+    spill = HostSpillStore(capacity_bytes=1 << 20)
+    jfn = plane.inject_program(pool)
+    probe = _KvSpillProbe(jfn, pool, plane, spill, length=6)
+
+    def run(caches, slabs, dst):
+        return probe(caches, slabs, dst)
+
+    slab = [(jnp.asarray(rng.randn(8, n_kv * head_dim).astype(dtype)),
+             jnp.asarray(rng.randn(8, n_kv * head_dim).astype(dtype)))]
+    args0 = (pool.caches, slab, jnp.int32(0))
+    variants = (probe, [
+        args0,
+        (pool.caches, slab, jnp.int32(1)),
+    ])
+    return {"trace": (run, args0),
+            "bound_axes": {"model"},
+            "variants": variants,
+            "data_axis": "model",
+            "arg_labels": ("dst_caches", "slabs", "dst"),
+            "expected_replication": {
+                "dst": "restore-slot index: one host-fed int32 scalar "
+                       "per restore, replicated to every TP rank by "
+                       "design",
+            }}
+
+
+class _RemotePullProbe:
+    """Variant probe for the fleet remote-pull path (ISSUE 12): every
+    call runs the full cross-worker host protocol around the compiled
+    inject program — owner pack (CRC stamped) → object lane put/get →
+    RESERVED destination slot → CRC-verified ``unpack_into`` →
+    reservation commit → recycle — and asserts the lane booking equals
+    the ``transfer_cost(mode="lanes")`` statics the router prices the
+    pull decision with.  The pull plane is host bookkeeping: one
+    compiled program, reservation invariants intact on every call."""
+
+    def __init__(self, jfn, src_pool, dst_pool, plane, length):
+        self._jfn = jfn
+        self._src = src_pool
+        self._dst = dst_pool
+        self._plane = plane
+        self._length = int(length)
+        self._calls = 0
+
+    def __call__(self, *a):
+        from chainermn_tpu.observability import flight
+        from chainermn_tpu.serving.transfer import transfer_cost
+        if _is_tracing(a):
+            # see _KvSpillProbe: the host protocol cannot run under
+            # the jaxpr trace; the inject program IS the device half
+            return self._jfn(*a)
+        self._calls += 1
+        L = self._length
+        tag = f"pfx/req-analysis-pull{self._calls:08d}"
+        with _traced_obs_state():
+            payload = self._plane.pack(
+                self._src, 0, L,
+                meta={"seq": list(range(L)), "length": L})
+            self._plane.lane_put(tag, payload)
+            slot = self._dst.reserve()
+            assert slot is not None
+            got = self._plane.lane_get(tag, 5.0)
+            stats = self._plane.unpack_into(got, self._dst, slot)
+            want = transfer_cost(self._dst.n_layers, L,
+                                 self._dst.kv_dim,
+                                 self._dst.caches[0][0].dtype,
+                                 mode="lanes")
+            assert stats["ledger_bytes"] == want["ledger_bytes"], (
+                stats, want)
+            self._dst.commit_reservation(slot)
+            self._dst.release(slot)      # recycle for the next call
+            self._plane.lane_delete(tag)
+            out = self._jfn(*a)
+            flight.note("fleet", event="remote_pull_done",
+                        prefix_len=L)
+            flight.note("phase", name="fleet/remote_pull")
+        return out
+
+    def _cache_size(self):
+        return self._jfn._cache_size()
+
+
+def _build_remote_pull() -> Dict[str, Any]:
+    """The fleet-global KV economy's remote prefix pull (ISSUE 12):
+    owner-side pack → object lane → CRC-verified landing into a
+    router-reserved slot through the pool-lifetime compiled inject
+    program.  Contract: one program across (slab, slot) variants, the
+    reservation state machine exercised on every call, lane bytes
+    ledger-reconciled against the same ``transfer_cost`` statics the
+    router's transfer-vs-re-prefill decision prices in token units."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from chainermn_tpu.serving.cache_pool import CachePool
+    from chainermn_tpu.serving.transfer import (InProcessLaneStore,
+                                                KvTransferPlane)
+
+    params, specs, mesh = _tiny_lm()
+    head_dim = 4
+    n_kv = 2  # _tiny_lm: 2 heads, no GQA
+    dtype = params["embed"].dtype
+    owner = CachePool(2, 8, 1, n_kv * head_dim, dtype, mesh, "model")
+    dst = CachePool(2, 8, 1, n_kv * head_dim, dtype, mesh, "model")
+    plane = KvTransferPlane(transport=InProcessLaneStore())
+    jfn = plane.inject_program(dst)
+    probe = _RemotePullProbe(jfn, owner, dst, plane, length=5)
+
+    rng = np.random.RandomState(_SEED)
+    slab = [(jnp.asarray(rng.randn(8, n_kv * head_dim).astype(dtype)),
+             jnp.asarray(rng.randn(8, n_kv * head_dim).astype(dtype)))]
+
+    def run(caches, slabs, dst_slot):
+        return probe(caches, slabs, dst_slot)
+
+    args0 = (dst.caches, slab, jnp.int32(0))
+    variants = (probe, [
+        args0,
+        (dst.caches, slab, jnp.int32(1)),
+    ])
+    return {"trace": (run, args0),
+            "bound_axes": {"model"},
+            "variants": variants,
+            "data_axis": "model",
+            "arg_labels": ("dst_caches", "slabs", "dst_slot"),
+            "expected_replication": {
+                "dst_slot": "reserved destination-slot index: one "
+                            "host-fed int32 scalar per landing, "
+                            "replicated to every TP rank by design",
+            }}
+
+
 def select_entrypoints(names=None, for_shardflow: bool = False):
     """Resolve ``--entry`` names against the registry — the ONE resolver
     both runners share (``cli.py`` and ``shardflow.main``).
@@ -939,6 +1176,28 @@ ENTRYPOINTS = [
                     "round trip per call: zero collectives, one "
                     "compiled program across (slab, dst slot) variants "
                     "(ISSUE 10)"),
+    EntryPoint(
+        name="serving.kv_spill",
+        build=_build_kv_spill,
+        shardflow=False,  # same compiled inject program as
+        #                   serving.worker_lane — the base entry owns
+        #                   its shard-flow analysis
+        description="host-RAM spill tier round trip (pack -> bounded "
+                    "LRU store -> CRC verify -> compiled restore): one "
+                    "program across (slab, slot) variants, byte-exact "
+                    "restores ledger-reconciled against transfer_cost "
+                    "statics (ISSUE 12)"),
+    EntryPoint(
+        name="serving.remote_pull",
+        build=_build_remote_pull,
+        shardflow=False,  # same compiled inject program as
+        #                   serving.worker_lane — the base entry owns
+        #                   its shard-flow analysis
+        description="fleet remote prefix pull (owner pack -> object "
+                    "lane -> reserved-slot CRC-verified landing): one "
+                    "program, reservation state machine exercised per "
+                    "call, lane bytes reconciled against the pricing "
+                    "statics (ISSUE 12)"),
     EntryPoint(
         name="serving.tick_with_tracing",
         build=_build_tick_with_tracing,

@@ -75,6 +75,18 @@ domains"):
   :func:`submit_with_retry` (the client-side honor of
   ``retry_after_ms``).
 
+Fleet KV economy (ISSUE 12; docs/SERVING.md "Fleet KV economy"):
+
+* :mod:`~chainermn_tpu.serving.fleet_cache` —
+  :class:`FleetCacheIndex`: the router's soft-state radix trie over
+  every worker's ANNOUNCED prefix-cache entries (epoch-fenced, rebuilt
+  on re-admission); a local miss with a remote hit becomes a priced
+  REMOTE PULL over the KV-transfer plane instead of a re-prefill.
+* :mod:`~chainermn_tpu.serving.spill` — :class:`HostSpillStore`: the
+  bounded host-RAM spill tier evicted prefix slabs fall into
+  (CRC-verified ``kv_transfer.v1`` payloads); a later hit restores
+  through the compiled inject path instead of re-prefilling.
+
 ``python -m chainermn_tpu.serve`` is the CLI demo over the toy-corpus
 LM from ``examples/generate`` (``--replicas N`` stands up the fleet,
 ``--disagg P:D`` the disaggregated topology, ``--fleet-procs N`` the
@@ -87,7 +99,9 @@ from .scheduler import (  # noqa: F401
     Scheduler,
 )
 from .cache_pool import SlotAllocator  # noqa: F401
+from .fleet_cache import FleetCacheIndex, IndexRecord  # noqa: F401
 from .prefix_cache import PrefixCache, PrefixEntry  # noqa: F401
+from .spill import HostSpillStore  # noqa: F401 — jax-free spill tier
 from .tenancy import (  # noqa: F401 — jax-free, like the scheduler
     DegradationLadder,
     Tenant,
@@ -96,6 +110,7 @@ from .tenancy import (  # noqa: F401 — jax-free, like the scheduler
 
 __all__ = ["AdmissionError", "Request", "Scheduler", "SlotAllocator",
            "PrefixCache", "PrefixEntry",
+           "FleetCacheIndex", "IndexRecord", "HostSpillStore",
            "TenantTable", "Tenant", "DegradationLadder",
            "AutoscalePolicy", "FleetAutoscaler", "derive_retry_after_ms",
            "ServingEngine", "RequestHandle", "CachePool", "DecodeEngine",

@@ -39,6 +39,15 @@ the transfer fails (lane fault, dead source worker).  The invariants
 are hard errors for the same reason double-release is: a leaked
 reservation silently shrinks the pool forever.
 
+Spill-tier extension (ISSUE 12): evicting a cached rc==0 slot no longer
+simply frees its K/V — the frontend packs the slab (CRC-stamped
+``chainermn_tpu.kv_transfer.v1`` payload) into the bounded host-RAM
+spill store (``spill.py``) BEFORE ``uncache`` resets the position, and
+a later matching prompt re-lands it through the compiled inject path.
+The allocator is untouched by the tier: spill rides the existing
+``cached → free`` transition via the prefix cache's pre-evict hook, so
+every slot-state invariant below holds unchanged.
+
 Prefix-cache extension (ISSUE 7): a slot now has THREE states, not two
 — ``free`` (on the free list), ``busy`` (a live request's K/V), and
 ``cached`` (a finished request's prompt K/V donated to the radix-trie

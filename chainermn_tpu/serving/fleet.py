@@ -59,12 +59,14 @@ from ..communicators.base import DcnLaneError
 from ..observability import flight as _flight
 from ..observability.slo import (GoodputLedger, ReservoirSample,
                                  SLOTracker, percentile_of)
+from .fleet_cache import FleetCacheIndex
 from .frontend import RequestHandle, _request_row
 from .health import (CircuitBreaker, EpochFence, LeaseTable,
                      detection_window_s)
 from .lanes import MailboxReceiver, MailboxSender
 from .router import RouterBase
 from .scheduler import AdmissionError, Request
+from .transfer import slab_nbytes, transfer_cost
 from .worker import ctl_mailbox, out_mailbox
 
 
@@ -175,7 +177,13 @@ class FleetRouter(RouterBase):
                  metrics_writer=None,
                  bundle_dir: Optional[str] = None,
                  lane_config=None,
-                 stats_capacity: int = 1024):
+                 stats_capacity: int = 1024,
+                 enable_remote_pulls: bool = True,
+                 pull_min_tokens: int = 4,
+                 pull_cost_per_token: float = 0.25,
+                 pull_timeout_s: float = 30.0,
+                 orphan_sweep_interval_s: float = 1.0,
+                 orphan_grace_s: float = 5.0):
         if not workers:
             raise ValueError("need at least one worker")
         names = [w.name for w in workers]
@@ -205,6 +213,28 @@ class FleetRouter(RouterBase):
         # the health.py read face: schema-checks every lease payload
         self._leases = LeaseTable(store, lane_config)
         self._last_supervise = 0.0
+        # fleet-global KV economy (ISSUE 12): the soft-state prefix
+        # index workers announce into, and the remote-pull pricing
+        # knobs — a pull is chosen only when the prefill tokens it
+        # saves beat its transfer price in the SAME token currency as
+        # the affinity score (pull_cost_per_token = moving one token's
+        # KV over the lane, priced relative to re-prefilling it)
+        self.cache_index = FleetCacheIndex()
+        self.enable_remote_pulls = bool(enable_remote_pulls)
+        self.pull_min_tokens = int(pull_min_tokens)
+        self.pull_cost_per_token = float(pull_cost_per_token)
+        self.pull_timeout_s = float(pull_timeout_s)
+        self._remote_pulls = 0
+        self.last_pull_fault: Optional[Dict[str, Any]] = None
+        # orphaned-slab sweep (ISSUE 12 satellite): a worker that died
+        # between pack-publish and install-ack leaks its lane tag
+        # forever without this — tags unowned by any in-flight request
+        # for a full grace window are GC'd
+        self.orphan_sweep_interval_s = float(orphan_sweep_interval_s)
+        self.orphan_grace_s = float(orphan_grace_s)
+        self._orphan_seen: Dict[str, float] = {}
+        self._last_orphan_sweep = 0.0
+        self._orphans_swept = 0
         for w in workers:
             # adopt the worker's pre-agreed first epoch (argv-passed)
             while (self.fence.current(w.name) or 0) < w.epoch:
@@ -341,6 +371,13 @@ class FleetRouter(RouterBase):
         req.status = "running"   # mirror: the worker owns queueing
         req.timestamps["submitted"] = now
         entry = {"req": req, "worker": wc.name, "attempts": 1}
+        # fleet KV economy (ISSUE 12): a local miss with a remote hit
+        # may be worth PULLING the prefix slab instead of re-prefilling
+        # — decided here, in token units, before anything is sent
+        pull = self._plan_pull(wc, prompt, trace_id)
+        if pull is not None:
+            entry["pull"] = dict(pull, attempts=1, state="requested",
+                                 t0=now)
         with self._lock:
             # registration and the death handler's strand snapshot
             # share this lock, so every accepted request is either in
@@ -358,6 +395,56 @@ class FleetRouter(RouterBase):
                 "worker_lost", trace_id,
                 f"fleet router thread died: {dead}",
                 retry_after_ms=1.0, queue_depth=0, tenant=tenant)
+        if pull is not None:
+            # the pull path holds the submit back until the prefix
+            # lands (or the pull degrades): the owner packs the slab,
+            # the destination installs it into its own prefix cache,
+            # and only then does the request dispatch — so its
+            # admission is a plain local hit, never a re-prefill race
+            owner_wc = self.workers.get(pull["owner"])
+            try:
+                self._send_cache_pull(owner_wc, req, pull)
+            except Exception as e:  # noqa: BLE001 — a broken OWNER
+                # lane must not reject the caller: degrade to plain
+                # dispatch on the chosen worker, counted.  Pop-or-bail:
+                # a supervisor running _cancel_pulls_on between the
+                # registration and this send may have ALREADY resolved
+                # the pull and dispatched the request — re-sending here
+                # would run the same trace twice on the worker
+                with self._lock:
+                    owned_pull = entry.pop("pull", None)
+                if owned_pull is None:
+                    _flight.note("fleet",
+                                 event="pull_send_superseded",
+                                 trace_id=trace_id, error=str(e))
+                    if self.tenancy is not None and tenant is not None:
+                        self.tenancy.on_admit(
+                            self.tenancy.resolve(tenant), req,
+                            capped=capped)
+                    obs.async_event("b", "request", trace_id,
+                                    cat="serving_request",
+                                    request=req.id,
+                                    prompt_len=req.prompt_len)
+                    return RequestHandle(req)
+                self.cache_index.count_stale("owner_lane")
+                _flight.note("fleet", event="remote_pull_fallback",
+                             trace_id=trace_id, reason="owner_lane",
+                             owner=pull["owner"], error=str(e))
+                pull = None
+            else:
+                _flight.note("fleet", event="remote_pull_requested",
+                             trace_id=trace_id, owner=pull["owner"],
+                             dst=wc.name, prefix_len=pull["length"],
+                             gain_tokens=pull["gain"],
+                             price_tokens=round(pull["price_tokens"], 2),
+                             ledger_bytes=pull["ledger_bytes"])
+                if self.tenancy is not None and tenant is not None:
+                    self.tenancy.on_admit(self.tenancy.resolve(tenant),
+                                          req, capped=capped)
+                obs.async_event("b", "request", trace_id,
+                                cat="serving_request", request=req.id,
+                                prompt_len=req.prompt_len)
+                return RequestHandle(req)
         try:
             self._send_submit(wc, req)
         except Exception as e:  # noqa: BLE001 — no half-registered state
@@ -430,6 +517,269 @@ class FleetRouter(RouterBase):
                         "req": self._wire(req)})
 
     # ------------------------------------------------------------------
+    # fleet KV economy: remote prefix pulls (ISSUE 12)
+    # ------------------------------------------------------------------
+    def _plan_pull(self, wc: WorkerClient, prompt,
+                   trace_id: str) -> Optional[Dict[str, Any]]:
+        """Transfer-vs-re-prefill decision, in token units.  The gain
+        is the prefill tokens a pull saves (remote match beyond the
+        local match); the price is the transfer's wire cost converted
+        through ``pull_cost_per_token`` (what moving one token's KV
+        over the lane costs relative to recomputing it) via the SAME
+        ``transfer_cost`` statics the ledger reconciles against.
+        Returns the pull plan, or None for plain dispatch."""
+        if not self.enable_remote_pulls or wc.role != "engine":
+            return None
+        live = {w.name for w in self._live("engine")}
+        rec, best_len = self.cache_index.match(prompt, workers=live)
+        if rec is None:
+            return None
+        local_len = self.cache_index.match_for(wc.name, prompt)
+        if rec.worker == wc.name or best_len <= local_len:
+            return None     # the local cache is already as good
+        gain = best_len - local_len
+        geom = rec.geom or {}
+        ledger_bytes = None
+        if geom:
+            cost = transfer_cost(geom["n_layers"], best_len,
+                                 geom["kv_dim"], geom["dtype"],
+                                 mode="lanes")
+            ledger_bytes = cost["ledger_bytes"]
+            per_token = max(slab_nbytes(geom["n_layers"], 1,
+                                        geom["kv_dim"], geom["dtype"]),
+                            1)
+            price_tokens = (self.pull_cost_per_token
+                            * ledger_bytes / per_token)
+        else:
+            # geometry never announced (old worker): price by rows
+            price_tokens = self.pull_cost_per_token * best_len
+        if gain < self.pull_min_tokens or gain <= price_tokens:
+            return None
+        return {"owner": rec.worker,
+                "seq": [int(t) for t in prompt[:best_len]],
+                # the index record's own key: a stale nack must drop
+                # the CLAIM that matched, not the (shorter) pull prefix
+                "rec_seq": list(rec.seq),
+                "length": int(best_len), "local_len": int(local_len),
+                "gain": int(gain), "price_tokens": float(price_tokens),
+                "ledger_bytes": ledger_bytes,
+                "tag": f"pfx/{trace_id}"}
+
+    def _send_cache_pull(self, owner_wc: WorkerClient, req: Request,
+                         pull: Dict[str, Any]) -> None:
+        if owner_wc is None or owner_wc.state not in ("starting", "live"):
+            raise RuntimeError(
+                f"slab owner {pull['owner']} is not live")
+        owner_wc.sender.send({"kind": "cache_pull",
+                              "epoch": owner_wc.epoch,
+                              "trace_id": req.trace_id,
+                              "prefix": pull["seq"],
+                              "length": pull["length"],
+                              "tag": pull["tag"]})
+
+    def _pull_fallback(self, entry: Dict[str, Any], reason: str,
+                       detail: str, *, lane=None, worker=None,
+                       fault: bool = False) -> None:
+        """The counted degrade-to-re-prefill path — every way a pull
+        can fail funnels here: pop the pull, count per reason, dump a
+        ``remote_pull_fault`` bundle naming worker+lane on the fault
+        reasons, GC the slab tag, and dispatch the request normally to
+        its already-chosen worker (failover owns it from there if even
+        that fails).  Done-XOR-shed holds throughout: the entry never
+        leaves ``_inflight`` here."""
+        req = entry["req"]
+        with self._lock:
+            pull = entry.pop("pull", None)
+        if pull is None:
+            return    # already resolved (installed, or raced a failover)
+        self.cache_index.count_stale(reason)
+        _flight.note("fleet", event="remote_pull_fallback",
+                     trace_id=req.trace_id, reason=reason,
+                     detail=detail,
+                     **({"worker": worker} if worker else {}),
+                     **({"lane": lane} if lane else {}))
+        if fault:
+            detection = {"trace_id": req.trace_id, "reason": reason,
+                         "detail": detail, "worker": worker,
+                         "lane": lane, "owner": pull["owner"],
+                         "dst": entry["worker"],
+                         "prefix_len": pull["length"]}
+            self.last_pull_fault = detection
+            _flight.note("fleet", event="remote_pull_fault", **detection)
+            if self.bundle_dir:
+                _flight.dump_bundle(
+                    self.bundle_dir, "remote_pull_fault",
+                    extra={"remote_pull_fault": detection})
+        self._gc_slab(pull["tag"])
+        wc = self.workers.get(entry["worker"])
+        if wc is not None and wc.state in ("starting", "live"):
+            try:
+                self._send_submit(wc, req)
+                _flight.note("fleet", event="dispatched",
+                             trace_id=req.trace_id, worker=wc.name,
+                             after_pull_fallback=reason)
+                return
+            except Exception as e:  # noqa: BLE001
+                detail = (f"{detail}; fallback submit to {wc.name} "
+                          f"failed: {e}")
+        self._failover(entry, f"remote pull fell back ({reason}): "
+                              f"{detail}")
+
+    def _cancel_pulls_on(self, worker: str, why: str,
+                         fault: bool = True) -> None:
+        """A dead/drained worker can never serve its pending pulls:
+        resolve every in-flight pull it owns to the counted fallback
+        (the mid-pull owner-death failure domain — chaos-proven by
+        SIGKILLing the slab owner)."""
+        with self._lock:
+            affected = [e for e in self._inflight.values()
+                        if e.get("pull") is not None
+                        and e["pull"]["owner"] == worker]
+        for entry in affected:
+            self._pull_fallback(
+                entry, "owner_lost", f"slab owner {worker} {why}",
+                worker=worker,
+                lane=f"worker_lane/{out_mailbox(worker)}/recv",
+                fault=fault)
+
+    def _check_pull_deadlines(self, now: float) -> None:
+        """Backstop: a pull neither completed nor failed within
+        ``pull_timeout_s`` (e.g. a silently wedged owner the lease
+        window has not caught yet) degrades instead of wedging the
+        request forever."""
+        with self._lock:
+            stuck = [e for e in self._inflight.values()
+                     if e.get("pull") is not None
+                     and now - e["pull"]["t0"] > self.pull_timeout_s]
+        for entry in stuck:
+            self._pull_fallback(
+                entry, "timeout",
+                f"pull did not complete within {self.pull_timeout_s}s")
+
+    def _on_cache_announce(self, wc: WorkerClient,
+                           msg: Dict[str, Any]) -> None:
+        op = str(msg.get("op"))
+        if op == "insert":
+            self.cache_index.insert(wc.name, wc.epoch, msg["prefix"],
+                                    msg["length"],
+                                    geom=msg.get("geom"))
+        elif op == "evict":
+            if msg.get("spilled"):
+                # device slot scavenged but the slab spilled to host
+                # RAM: still pullable, just from the colder tier
+                self.cache_index.demote(wc.name, msg["prefix"])
+            else:
+                # tier-scoped when the announce says so (a spill-store
+                # eviction must not drop a re-donated HOT claim)
+                self.cache_index.evict(wc.name, msg["prefix"],
+                                       tier=msg.get("tier"))
+        elif op == "snapshot":
+            self.cache_index.snapshot(wc.name, wc.epoch,
+                                      msg.get("entries") or [],
+                                      geom=msg.get("geom"))
+        else:
+            _flight.note("fleet", event="unknown_cache_announce",
+                         worker=wc.name, op=op)
+
+    def _live_pull(self, entry, wc_name: Optional[str] = None,
+                   owner: Optional[str] = None):
+        """The entry's pull iff it is still the CURRENT attempt's (a
+        failover since the request left supersedes every pull message
+        still in flight)."""
+        if entry is None:
+            return None
+        pull = entry.get("pull")
+        if pull is None or pull["attempts"] != entry["attempts"]:
+            return None
+        if owner is not None and pull["owner"] != owner:
+            return None
+        if wc_name is not None and entry["worker"] != wc_name:
+            return None
+        return pull
+
+    def _on_cache_slab_ready(self, wc: WorkerClient,
+                             msg: Dict[str, Any]) -> None:
+        entry = self._entry(msg.get("trace_id"))
+        pull = self._live_pull(entry, owner=wc.name)
+        if pull is None or pull.get("state") != "requested":
+            self._gc_slab(msg.get("tag"))
+            return
+        pull["state"] = "installing"
+        dst = self.workers.get(entry["worker"])
+        if dst is None or dst.state not in ("starting", "live"):
+            # the destination died since; its failover owns the request
+            self._gc_slab(msg.get("tag"))
+            return
+        try:
+            dst.sender.send({"kind": "install_prefix",
+                             "epoch": dst.epoch,
+                             "trace_id": msg["trace_id"],
+                             "tag": msg["tag"],
+                             "length": msg.get("length")})
+        except Exception as e:  # noqa: BLE001
+            self._pull_fallback(
+                entry, "dst_lane",
+                f"install_prefix send to {dst.name} failed: {e}",
+                worker=dst.name,
+                lane=f"worker_lane/{ctl_mailbox(dst.name)}/send",
+                fault=isinstance(e, DcnLaneError))
+
+    def _on_cache_pull_nack(self, wc: WorkerClient,
+                            msg: Dict[str, Any]) -> None:
+        entry = self._entry(msg.get("trace_id"))
+        pull = self._live_pull(entry, owner=wc.name)
+        if pull is None:
+            self._gc_slab(msg.get("tag"))
+            return
+        reason = str(msg.get("reason"))
+        if reason == "stale":
+            # evicted since the announce: drop the claim so the next
+            # submit does not re-plan the same dead pull
+            self.cache_index.evict(wc.name,
+                                   pull.get("rec_seq") or pull["seq"])
+        self._pull_fallback(
+            entry, reason,
+            f"owner {wc.name} nacked the pull: {reason}",
+            worker=wc.name, lane=msg.get("lane"),
+            fault=(reason == "publish_fault"))
+
+    def _on_prefix_installed(self, wc: WorkerClient,
+                             msg: Dict[str, Any]) -> None:
+        entry = self._entry(msg.get("trace_id"))
+        pull = self._live_pull(entry, wc_name=wc.name)
+        if pull is None:
+            return
+        with self._lock:
+            entry.pop("pull", None)
+            self._remote_pulls += 1
+        req = entry["req"]
+        _flight.note("fleet", event="remote_pull_done",
+                     trace_id=req.trace_id, owner=pull["owner"],
+                     dst=wc.name, prefix_len=pull["length"],
+                     pull_ms=round((time.monotonic() - pull["t0"]) * 1e3,
+                                   2))
+        try:
+            self._send_submit(wc, req)
+        except Exception as e:  # noqa: BLE001
+            self._failover(entry, f"submit after remote pull to "
+                                  f"{wc.name} failed: {e}")
+
+    def _on_prefix_nack(self, wc: WorkerClient,
+                        msg: Dict[str, Any]) -> None:
+        entry = self._entry(msg.get("trace_id"))
+        pull = self._live_pull(entry, wc_name=wc.name)
+        if pull is None:
+            self._gc_slab(msg.get("tag"))
+            return
+        reason = str(msg.get("reason"))
+        self._pull_fallback(
+            entry, reason,
+            f"destination {wc.name} could not land the prefix slab: "
+            f"{reason}",
+            worker=wc.name, lane=msg.get("lane"),
+            fault=(reason == "lane_fault"))
+
+    # ------------------------------------------------------------------
     # pump: worker -> router messages
     # ------------------------------------------------------------------
     def pump(self) -> int:
@@ -472,6 +822,16 @@ class FleetRouter(RouterBase):
                     pass   # ownership already moved at forward time
                 elif kind == "install_nack":
                     self._on_install_nack(wc, msg)
+                elif kind == "cache_announce":
+                    self._on_cache_announce(wc, msg)
+                elif kind == "cache_slab_ready":
+                    self._on_cache_slab_ready(wc, msg)
+                elif kind == "cache_pull_nack":
+                    self._on_cache_pull_nack(wc, msg)
+                elif kind == "prefix_installed":
+                    self._on_prefix_installed(wc, msg)
+                elif kind == "prefix_nack":
+                    self._on_prefix_nack(wc, msg)
                 else:
                     _flight.note("fleet", event="unknown_msg",
                                  worker=wc.name, msg_kind=kind)
@@ -686,6 +1046,51 @@ class FleetRouter(RouterBase):
                         wc, f"never published a lease within the "
                             f"start grace ({self.start_grace_s}s)")
         self._sweep_orphaned_inflight()
+        self._check_pull_deadlines(now)
+        self._sweep_orphan_tags(now)
+
+    def _sweep_orphan_tags(self, now: float) -> None:
+        """Periodic lane-dir sweep (ISSUE 12 satellite): a worker that
+        died between publishing a slab (``slab/``/``pfx/`` tag) and the
+        install-ack leaks the tag forever — only the CAUGHT fault path
+        GC'd before this.  A tag owned by no in-flight request for a
+        full ``orphan_grace_s`` window is deleted; the grace window
+        keeps a tag published a beat before its announce arrives from
+        being swept out from under a live transfer."""
+        if now - self._last_orphan_sweep < self.orphan_sweep_interval_s:
+            return
+        self._last_orphan_sweep = now
+        tags_fn = getattr(self.store, "tags", None)
+        if tags_fn is None:
+            return
+        try:
+            tags = tags_fn()
+        except Exception as e:  # noqa: BLE001 — a sweep must never
+            # hurt the supervisor
+            _flight.note("fleet", event="orphan_sweep_failed",
+                         error=str(e))
+            return
+        with self._lock:
+            live = set(self._inflight)
+        present = set()
+        for tag in tags:
+            if not (tag.startswith("slab/") or tag.startswith("pfx/")):
+                continue
+            present.add(tag)
+            trace_id = tag.split("/", 1)[1]
+            if trace_id in live:
+                self._orphan_seen.pop(tag, None)
+                continue
+            t0 = self._orphan_seen.setdefault(tag, now)
+            if now - t0 >= self.orphan_grace_s:
+                self._gc_slab(tag)
+                self._orphan_seen.pop(tag, None)
+                self._orphans_swept += 1
+                _flight.note("fleet", event="orphan_slab_swept",
+                             tag=tag)
+        for tag in list(self._orphan_seen):
+            if tag not in present:
+                self._orphan_seen.pop(tag, None)
 
     def _sweep_orphaned_inflight(self) -> None:
         """Fail over in-flight entries owned by a dead/drained worker.
@@ -727,6 +1132,12 @@ class FleetRouter(RouterBase):
         wc.state = "dead"
         self.fence.fence(wc.name)
         wc.breaker.record_failure()
+        # the fleet cache index is SOFT state of this corpse: drop
+        # every entry for the fenced epoch in one sweep, and resolve
+        # every pull it owed to the counted re-prefill fallback (the
+        # mid-pull owner-death failure domain, ISSUE 12)
+        self.cache_index.drop_worker(wc.name)
+        self._cancel_pulls_on(wc.name, f"died ({why})")
         lane = f"worker_lane/{out_mailbox(wc.name)}/recv"
         outcomes = []
         with self._lock:
@@ -777,8 +1188,13 @@ class FleetRouter(RouterBase):
             entry["install_nacks"] = 0     # fresh budget per attempt
             # any slab the dead attempt published is superseded by the
             # re-prefill; drop it from the lane store (no-op for
-            # engine-role fleets — they publish no slabs)
+            # engine-role fleets — they publish no slabs), and any
+            # pending prefix pull is superseded too (its messages are
+            # refused by the attempts stamp)
+            with self._lock:
+                entry.pop("pull", None)
             self._gc_slab(f"slab/{req.trace_id}")
+            self._gc_slab(f"pfx/{req.trace_id}")
             # deterministic re-generation: reset streamed state, keep
             # the original submit stamp so the failover TTFT penalty is
             # measured end to end
@@ -854,6 +1270,7 @@ class FleetRouter(RouterBase):
         req.shed_payload = shed.to_dict()
         req.finish("shed", time.monotonic())
         self._gc_slab(f"slab/{req.trace_id}")
+        self._gc_slab(f"pfx/{req.trace_id}")
         if self.metrics_writer is not None:
             self.metrics_writer.write(
                 dict(reason="worker_lost", trace_id=req.trace_id,
@@ -880,6 +1297,8 @@ class FleetRouter(RouterBase):
     def _on_drained(self, wc: WorkerClient) -> None:
         wc.state = "drained"
         self.fence.fence(wc.name)   # nothing further may land
+        self.cache_index.drop_worker(wc.name)
+        self._cancel_pulls_on(wc.name, "drained", fault=False)
         _flight.note("fleet", event="drained", worker=wc.name)
         if self.bundle_dir:
             _flight.dump_bundle(
@@ -1051,6 +1470,29 @@ class FleetRouter(RouterBase):
             out[f"fleet/rejected/{reason}"] = float(n)
         for kind, n in sorted(self.fence.refusal_counts().items()):
             out[f"fleet/fenced_refusals/{kind}"] = float(n)
+        # fleet KV economy (ISSUE 12): index + pull counters, plus the
+        # worker-side spill/restore/CRC counters aggregated from the
+        # leases (the workers count their own refusals; the router
+        # never double-books them)
+        idx = self.cache_index
+        out["fleet/cache/index_entries"] = float(idx.n_entries)
+        out["fleet/cache/hits"] = float(idx.hits)
+        out["fleet/cache/misses"] = float(idx.misses)
+        with self._lock:
+            out["fleet/cache/remote_pulls"] = float(self._remote_pulls)
+        stale = dict(idx.stale_fallbacks)
+        out["fleet/cache/stale_fallbacks"] = float(sum(stale.values()))
+        for reason, n in sorted(stale.items()):
+            out[f"fleet/cache/stale_fallbacks/{reason}"] = float(n)
+        out["fleet/cache/orphan_tags_swept"] = float(self._orphans_swept)
+        agg = {"spills": 0, "restores": 0, "crc_refusals": 0,
+               "prefill_calls": 0, "pull_serves": 0, "pull_installs": 0}
+        for w in self.workers.values():
+            c = (w.last_lease or {}).get("cache") or {}
+            for k in agg:
+                agg[k] += int(c.get(k, 0))
+        for k, v in agg.items():
+            out[f"fleet/cache/{k}"] = float(v)
         offered = dispatched + sum(rejected.values()) - shed_inflight
         out["fleet/shed_rate"] = (
             sum(rejected.values()) / offered if offered else 0.0)
@@ -1079,9 +1521,15 @@ class FleetRouter(RouterBase):
             self._results = 0
             self._t0 = time.monotonic()
             self._rejected = {r: 0 for r in self._rejected}
+            self._remote_pulls = 0
+            self._orphans_swept = 0
             self._ttft_ms = ReservoirSample(self._ttft_ms.capacity)
             self._failover_ttft_ms = ReservoirSample(
                 self._failover_ttft_ms.capacity)
+        # one epoch for every cache-economy rate counter: warm-up
+        # hits/misses/stale fallbacks must not leak into the measured
+        # window the bench gates on
+        self.cache_index.reset_counters()
         self.goodput.reset()
 
     def requests_table(self) -> Dict[str, Any]:
@@ -1112,6 +1560,19 @@ class FleetRouter(RouterBase):
         state["lease_window_s"] = self.lease_window_s
         state["fenced_refusals"] = self.fence.refusal_counts()
         state["last_detection"] = self.last_detection
+        # the fleet cache-index block (ISSUE 12): who claims which
+        # prefixes at which tier, pull counters, and the last pull
+        # fault — what a KV-economy postmortem reads first
+        with self._lock:
+            remote_pulls = self._remote_pulls
+            pending_pulls = sum(
+                1 for e in self._inflight.values() if "pull" in e)
+        state["cache_index"] = dict(
+            self.cache_index.state(),
+            remote_pulls=remote_pulls,
+            pending_pulls=pending_pulls,
+            orphan_tags_swept=self._orphans_swept,
+            last_pull_fault=self.last_pull_fault)
         # the autoscaler's view (ISSUE 11 satellite): live /statusz and
         # the flight bundle agree on WHY the fleet is its current size
         # — target per role, last decision + reason, and every tenant's

@@ -169,7 +169,8 @@ class ServingEngine:
                  slo: Optional[SLOTracker] = None,
                  recent_capacity: int = 64,
                  prefix_cache: bool = True,
-                 min_prefix_len: int = 2):
+                 min_prefix_len: int = 2,
+                 spill_bytes: int = 32 << 20):
         from ..parallel.decode import _kv_heads
 
         n_kv = _kv_heads(params, head_dim)
@@ -197,7 +198,31 @@ class ServingEngine:
                 retain_slot=self.pool.retain,
                 release_slot=self.pool.unretain,
                 evict_slot=self.pool.uncache,
-                min_prefix_len=min_prefix_len)
+                min_prefix_len=min_prefix_len,
+                on_insert=self._on_prefix_insert,
+                on_evict=self._on_prefix_evict)
+        # host-RAM spill tier (ISSUE 12): a scavenged rc==0 prefix slot
+        # spills its CRC-stamped slab into a bounded LRU host store
+        # instead of vanishing; a later matching prompt restores it
+        # through the pool-lifetime compiled inject program instead of
+        # re-prefilling.  spill_bytes=0 disables the tier.
+        self.spill = None
+        self._spill_plane = None
+        if prefix_cache and int(spill_bytes) > 0:
+            from .spill import HostSpillStore
+            from .transfer import KvTransferPlane
+            self.spill = HostSpillStore(
+                capacity_bytes=int(spill_bytes),
+                on_evict=self._on_spill_evict)
+            self._spill_plane = KvTransferPlane()
+        # fleet-economy hooks (ISSUE 12): the cross-process worker
+        # announces this engine's cache lifecycle over the mailbox wire
+        # so the router's global index can route remote pulls here.
+        # ``on_cache_insert(entry)``, ``on_cache_evict(entry, spilled)``,
+        # ``on_spill_evict(seq, length)``.
+        self.on_cache_insert = None
+        self.on_cache_evict = None
+        self.on_spill_evict = None
         self.metrics_writer = metrics_writer
         self._running: Dict[int, Request] = {}   # slot -> request
         self._lock = threading.Lock()            # guards _running + stats
@@ -459,8 +484,39 @@ class ServingEngine:
                 # one; only the deadline can evict before that
                 self._maybe_evict(req, time.monotonic())
                 continue
+            # device-cache miss: the host spill tier may still hold the
+            # prefix (ISSUE 12) — restore lands the CRC-verified slab
+            # straight into THIS request's slot and feeds the suffix
+            # through the shared tick, exactly the copy-on-extend shape
+            if self.spill is not None:
+                t_rs = time.monotonic()
+                self.goodput.add("host", t_rs - t_host)
+                with obs.span("serving/spill_restore",
+                              cat="serving_request", request=req.id,
+                              trace_id=req.trace_id, slot=slot):
+                    rlen = self._try_restore(req, slot)
+                t_host = time.monotonic()
+                self.goodput.add("compute" if rlen else "host",
+                                 t_host - t_rs)
+                if rlen:
+                    req.forced.extend(req.prompt[rlen:])
+                    self._set_slot_sampling(slot, req)
+                    obs.instant("serving/request/spill_restore",
+                                cat="serving", request=req.id,
+                                slot=slot, trace_id=req.trace_id,
+                                prefix_len=rlen)
+                    _flight.note("serving", event="restore",
+                                 request=req.id, trace_id=req.trace_id,
+                                 slot=slot, prefix_len=rlen)
+                    with self._lock:
+                        self._running[slot] = req
+                    self._maybe_evict(req, time.monotonic())
+                    continue
             try:
-                self.goodput.add("host", t_admit - t_host)
+                # a failed restore attempt above already booked its own
+                # wall and advanced t_host past t_admit — never book a
+                # negative host segment
+                self.goodput.add("host", max(t_admit - t_host, 0.0))
                 compiles_before = self.engine.prefill_compiles
                 t_pf = time.monotonic()
                 with obs.span("serving/prefill", cat="serving_request",
@@ -710,6 +766,97 @@ class ServingEngine:
                 return
         self.pool.release(slot)
 
+    # ---- KV-economy lifecycle (ISSUE 12): spill tier + fleet hooks ----
+    def _on_prefix_insert(self, entry) -> None:
+        if self.on_cache_insert is not None:
+            self.on_cache_insert(entry)
+
+    def _on_prefix_evict(self, entry) -> None:
+        """Fires BEFORE the evicted entry's slot returns to the free
+        list: pack its K/V into the host spill tier (so the prefix
+        stays restorable), then tell the fleet layer whether the
+        eviction demoted (spilled) or dropped the prefix."""
+        spilled = self._maybe_spill(entry)
+        if self.on_cache_evict is not None:
+            self.on_cache_evict(entry, spilled)
+
+    def _maybe_spill(self, entry) -> bool:
+        if self.spill is None:
+            return False
+        try:
+            payload = self._spill_plane.pack(
+                self.pool, entry.slot, entry.length,
+                meta={"seq": list(entry.seq), "length": entry.length})
+            ok = self.spill.put(entry.seq, entry.length, payload)
+        except Exception as e:  # noqa: BLE001 — a failed spill must
+            # never break the eviction it rides on; the prefix just
+            # re-prefills like it always did
+            _flight.note("serving", event="spill_failed",
+                         slot=entry.slot, error=repr(e))
+            return False
+        if ok:
+            _flight.note("serving", event="spill", slot=entry.slot,
+                         prefix_len=entry.length,
+                         bytes=len(payload),
+                         store_bytes=self.spill.bytes_held)
+            obs.instant("serving/spill", cat="serving",
+                        prefix_len=entry.length, bytes=len(payload))
+        return ok
+
+    def _on_spill_evict(self, seq, length) -> None:
+        if self.on_spill_evict is not None:
+            self.on_spill_evict(seq, length)
+
+    def _try_restore(self, req: Request, slot: int) -> int:
+        """Restore a spilled prefix directly into the request's own
+        slot through the compiled inject program; returns the restored
+        prefix length (0 = no usable spill, or the payload failed its
+        CRC and the request falls back to a normal prefill).
+
+        The payload may hold MORE rows than the prompt shares with the
+        spilled sequence: every row is injected (the program takes no
+        length operand), then ``pos`` is clamped to the matched length
+        — rows above it are stale-but-unreachable by the standard
+        masking argument (the occupant rewrites row ``p`` before its
+        own ``pos`` reaches ``p``)."""
+        from .transfer import SPILL_AXIS, SPILL_OP
+
+        min_len = (self.prefix_cache.min_prefix_len
+                   if self.prefix_cache is not None else 2)
+        hit = self.spill.match(req.prompt, min_len=min_len)
+        if hit is None:
+            return 0
+        seq, mlen = hit
+        payload = self.spill.get(seq)
+        if payload is None:
+            return 0
+        try:
+            self._spill_plane.unpack_into(
+                payload, self.pool, slot,
+                ledger_op=SPILL_OP, ledger_axis=SPILL_AXIS)
+        except ValueError as e:
+            # CRC/schema/shape refusal: corrupt spill state is dropped
+            # and counted, and the request re-prefills — wrong KV is
+            # never served (the ISSUE 12 acceptance)
+            self.spill.crc_refusals += 1
+            self.spill.drop(seq)
+            _flight.note("serving", event="spill_crc_refused",
+                         request=req.id, trace_id=req.trace_id,
+                         error=str(e))
+            obs.instant("serving/spill_crc_refused", cat="serving",
+                        request=req.id, trace_id=req.trace_id)
+            return 0
+        except Exception as e:  # noqa: BLE001 — inject failure: the
+            # pool is unchanged (functional update never assigned);
+            # fall back to the normal prefill
+            _flight.note("serving", event="restore_failed",
+                         request=req.id, trace_id=req.trace_id,
+                         error=repr(e))
+            return 0
+        self.pool.pos[slot] = int(mlen)
+        self.spill.restores += 1
+        return int(mlen)
+
     # ---- driving ----
     def run(self, steps_budget: Optional[int] = None,
             drain: bool = True) -> int:
@@ -790,6 +937,12 @@ class ServingEngine:
                 pc.hits = pc.misses = pc.tokens_reused = 0
                 pc.insertions = pc.rejected_insertions = 0
                 pc.evictions = 0
+            if self.spill is not None:
+                # same discipline: counters reset, spilled payloads stay
+                sp = self.spill
+                sp.spills = sp.restores = sp.hits = sp.misses = 0
+                sp.crc_refusals = sp.evictions = 0
+                sp.rejected_oversize = 0
 
     def metrics(self) -> Dict[str, float]:
         """Host-side serving summary (the Prometheus ``extra_gauges`` /
@@ -826,6 +979,9 @@ class ServingEngine:
                 out[f"serving/prefix/{k}"] = v
             out["serving/prefix/cached_slots"] = float(
                 self.pool.cached_count)
+        if self.spill is not None:
+            for k, v in self.spill.stats().items():
+                out[f"serving/spill/{k}"] = v
         out.update(self.goodput.gauges("serving/goodput"))
         return out
 
@@ -870,6 +1026,8 @@ class ServingEngine:
                 self.prefix_cache.stats(),
                 cached_slots=self.pool.cached_count,
                 total_refcount=self.prefix_cache.total_refcount())
+        if self.spill is not None:
+            state["spill"] = self.spill.state()
         if self.slo is not None:
             state["slo"] = self.slo.status()
         return state

@@ -58,6 +58,28 @@ def _safe_tag(tag: str) -> str:
         for c in str(tag))
 
 
+def _unsafe_tag(name: str) -> str:
+    """Inverse of :func:`_safe_tag`: decode a lane filename back into
+    its logical tag (the supervisor's orphan-slab sweep enumerates the
+    lane directory and must reason about TAGS, not filenames).  The
+    encoding is injective and fixed-width, so decoding is unambiguous;
+    a malformed name (torn tmp file, foreign debris) raises
+    ``ValueError`` — the sweeper skips it rather than guessing."""
+    out = bytearray()
+    i, n = 0, len(name)
+    while i < n:
+        c = name[i]
+        if c == "_":
+            if i + 3 > n:
+                raise ValueError(f"truncated escape in lane name {name!r}")
+            out.extend(bytes([int(name[i + 1:i + 3], 16)]))
+            i += 3
+        else:
+            out.extend(c.encode("utf-8"))
+            i += 1
+    return out.decode("utf-8")
+
+
 class FileLaneStore:
     """Directory-backed object lane: the cross-process transport for
     fleets of unrelated processes (no fixed-size gang, no coordinator).
@@ -111,6 +133,24 @@ class FileLaneStore:
             os.unlink(self._path(tag))
         except FileNotFoundError:
             pass
+
+    def tags(self):
+        """Every currently published tag (decoded lane filenames; tmp
+        files and undecodable debris skipped) — the supervisor's
+        orphan-slab sweep face (ISSUE 12)."""
+        out = []
+        try:
+            names = os.listdir(self.root)
+        except OSError:
+            return out
+        for name in names:
+            if name.startswith(".tmp-"):
+                continue
+            try:
+                out.append(_unsafe_tag(name))
+            except (ValueError, UnicodeDecodeError):
+                continue
+        return out
 
 
 def lane_try_get(store, lane: str, tag: str,

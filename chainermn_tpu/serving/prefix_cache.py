@@ -117,7 +117,8 @@ class PrefixCache:
     """
 
     def __init__(self, retain_slot=None, release_slot=None,
-                 evict_slot=None, min_prefix_len: int = 2):
+                 evict_slot=None, min_prefix_len: int = 2,
+                 on_insert=None, on_evict=None):
         # one reentrant lock around every trie/entry mutation AND read:
         # with Replica.start() the engine's driver thread donates and
         # evicts while the router's caller thread peeks for affinity —
@@ -135,6 +136,19 @@ class PrefixCache:
         self._retain_slot = retain_slot or (lambda slot: None)
         self._release_slot = release_slot or (lambda slot: None)
         self._evict_slot = evict_slot or (lambda slot: None)
+        # lifecycle hooks (ISSUE 12): ``on_insert(entry)`` after a
+        # donation lands, ``on_evict(entry)`` BEFORE the slot is handed
+        # back (the spill tier must pack the rows while they still
+        # exist; the fleet worker announces both over the mailbox wire
+        # so the router's global index tracks this cache).  Hooks run
+        # UNDER the cache lock by design — the pre-evict spill has to
+        # read the slab before the slot frees, and that ordering only
+        # exists inside the eviction.  The cost is bounded (one slab's
+        # device→host copy + small lane writes) but it does extend the
+        # lock hold on the eviction path; hooks must never take a lock
+        # that can be held while calling INTO this cache (deadlock).
+        self.on_insert = on_insert
+        self.on_evict = on_evict
         # counters (the frontend's metrics() / introspect surface)
         self.hits = 0
         self.misses = 0
@@ -221,6 +235,29 @@ class PrefixCache:
         match_len = min(depth, entry.length, len(prompt) - 1)
         return match_len if match_len >= self.min_prefix_len else 0
 
+    @_locked
+    def pin_covering(self, seq) -> Optional[PrefixEntry]:
+        """Entry whose K/V rows COVER ``seq`` exactly (``entry.seq[:
+        len(seq)] == seq`` and ``entry.length >= len(seq)``), RETAINED
+        atomically — the remote-pull serving face (ISSUE 12): the owner
+        must pin the entry across the pack so a concurrent eviction
+        cannot free the slot mid-read.  Returns None (no pin taken)
+        when nothing covers the sequence anymore — the announced claim
+        went stale and the pull degrades to re-prefill."""
+        seq = tuple(int(t) for t in seq)
+        if not seq:
+            return None
+        node, depth, partial = self._walk(seq)
+        if depth < len(seq):
+            return None
+        entry = self._subtree_entry(partial if partial is not None
+                                    else node)
+        if entry is None or entry.length < len(seq) \
+                or entry.seq[: len(seq)] != seq:
+            return None
+        self.retain(entry)
+        return entry
+
     # ---- pinning (request lifetime) ----
     @_locked
     def retain(self, entry: PrefixEntry) -> None:
@@ -274,6 +311,8 @@ class PrefixCache:
         self._entries[entry.id] = entry
         self._by_slot[slot] = entry
         self.insertions += 1
+        if self.on_insert is not None:
+            self.on_insert(entry)
         # a strictly-shorter entry whose seq prefixes the new one is
         # subsumed: every hit it could serve, the new entry serves
         # better.  Evict the unpinned ones now (their slot frees up).
@@ -327,6 +366,10 @@ class PrefixCache:
         allocator would refuse the uncache anyway)."""
         if self._pins.get(entry.id, 0) > 0:
             raise ValueError(f"{entry!r} is pinned; refusing eviction")
+        if self.on_evict is not None:
+            # BEFORE the slot goes back: the spill tier packs the rows
+            # while the slot still holds them (evict_slot resets pos)
+            self.on_evict(entry)
         del self._entries[entry.id]
         self._by_slot.pop(entry.slot, None)
         node = entry.node

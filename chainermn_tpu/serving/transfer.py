@@ -42,6 +42,7 @@ from __future__ import annotations
 import pickle
 import threading
 import time
+import zlib
 from typing import Any, Dict, Optional
 
 import numpy as np
@@ -54,6 +55,29 @@ WIRE_SCHEMA = "chainermn_tpu.kv_transfer.v1"
 #: shard-flow/bench reconciliation joins on it.
 LANE_OP = "kv_transfer_lane"
 LANE_AXIS = "dcn"
+
+#: The ledger key a host-RAM spill RESTORE books under (ISSUE 12): the
+#: same payload format and inject program as a lane transfer, but the
+#: slab never crossed DCN — it round-tripped through the local spill
+#: tier, so pricing it as DCN traffic would corrupt the wire-byte gate.
+SPILL_OP = "kv_spill_restore"
+SPILL_AXIS = "host"
+
+
+def slab_crc32(rows) -> int:
+    """CRC32 over the packed slab's raw K/V bytes, in layer order (K
+    then V per layer) — the end-to-end integrity stamp every
+    ``chainermn_tpu.kv_transfer.v1`` payload carries (ISSUE 12).  The
+    checksum covers the KV numbers themselves, so a slab corrupted
+    anywhere between :meth:`KvTransferPlane.pack` and
+    :meth:`KvTransferPlane.unpack_into` (lane store, host spill tier,
+    a bad DIMM) is REFUSED at landing rather than silently decoded
+    into wrong-but-plausible tokens."""
+    crc = 0
+    for k, v in rows:
+        crc = zlib.crc32(np.ascontiguousarray(k).tobytes(), crc)
+        crc = zlib.crc32(np.ascontiguousarray(v).tobytes(), crc)
+    return crc & 0xFFFFFFFF
 
 
 def _shard_axis_of(spec, axis_name: str) -> Optional[int]:
@@ -146,6 +170,14 @@ class InProcessLaneStore:
     def delete(self, tag: str) -> None:
         with self._cv:
             self._store.pop(str(tag), None)
+
+    def tags(self):
+        """Snapshot of every published tag — the supervisor's orphan
+        sweep face (ISSUE 12): a slab tag left by a worker that died
+        between pack-publish and install-ack is visible here, owned by
+        nobody, and must eventually be GC'd."""
+        with self._cv:
+            return list(self._store)
 
 
 class KvTransferPlane:
@@ -305,6 +337,11 @@ class KvTransferPlane:
             "n_layers": src_pool.n_layers,
             "kv_dim": src_pool.kv_dim,
             "dtype": str(rows[0][0].dtype),
+            # end-to-end integrity stamp (ISSUE 12): the receiver
+            # recomputes this over the decoded rows and REFUSES a
+            # mismatch — a corrupt slab degrades to re-prefill, it is
+            # never served
+            "crc32": slab_crc32(rows),
             "rows": rows,
         }, protocol=pickle.HIGHEST_PROTOCOL)
 
@@ -377,14 +414,21 @@ class KvTransferPlane:
             _flight.note("compile", program="serving_kv_inject")
         return prog
 
-    def unpack_into(self, payload: bytes, dst_pool,
-                    dst_slot: int) -> Dict[str, Any]:
+    def unpack_into(self, payload: bytes, dst_pool, dst_slot: int, *,
+                    ledger_op: str = LANE_OP,
+                    ledger_axis: str = LANE_AXIS) -> Dict[str, Any]:
         """Inject a packed slab into ``dst_slot`` (compiled pool-
         lifetime slab write; the host pads the slab to the pool row so
         the program needs no length operand) and book the RAW slab
-        bytes as a noted ``kv_transfer_lane@dcn`` ledger row — the
-        exact :func:`transfer_cost(mode="lanes")` prediction.  Returns
-        the wire dict's ``meta`` + transfer stats."""
+        bytes as a noted ``ledger_op@ledger_axis`` row — by default the
+        ``kv_transfer_lane@dcn`` key, the exact
+        :func:`transfer_cost(mode="lanes")` prediction; the host spill
+        tier restores under ``kv_spill_restore@host`` so its traffic
+        never pollutes the DCN wire-byte gate (ISSUE 12).  The payload's
+        CRC32 stamp is verified BEFORE anything touches the pool: a
+        corrupt or foreign slab is refused with :class:`ValueError`,
+        never decoded.  Returns the wire dict's ``meta`` + transfer
+        stats."""
         import jax.numpy as jnp
 
         t0 = time.monotonic()
@@ -405,6 +449,15 @@ class KvTransferPlane:
             raise ValueError(
                 f"slab length {length} exceeds destination per-slot "
                 f"capacity {dst_pool.max_total}")
+        want_crc = data.get("crc32")
+        if want_crc is not None:
+            got_crc = slab_crc32(data["rows"])
+            if got_crc != int(want_crc):
+                raise ValueError(
+                    f"refusing KV transfer: CRC mismatch (payload says "
+                    f"{int(want_crc):#010x}, rows hash {got_crc:#010x}) "
+                    f"— the slab was corrupted in transit/storage and "
+                    f"must re-prefill, never serve")
 
         prog = self.inject_program(dst_pool)
         # pad each layer's rows to the pool row (rows above ``length``
@@ -437,7 +490,7 @@ class KvTransferPlane:
         from ..observability import trace as _trace
         if _trace.get_tracer().enabled:
             _comm.get_accountant().record(
-                LANE_OP, LANE_AXIS, nbytes, data["dtype"],
+                ledger_op, ledger_axis, nbytes, data["dtype"],
                 in_jit=False, latency_s=ms / 1e3, noted=True)
         return {"mode": "lanes", "ms": ms, "ledger_bytes": nbytes,
                 "wire_payload_bytes": len(payload), "length": length,

@@ -107,7 +107,8 @@ class WorkerRuntime:
                  queue_capacity: int = 16, staging_slots: int = 2,
                  max_prefills_per_tick: int = 1, prefill_bucket: int = 1,
                  mesh=None, axis_name: str = "model",
-                 prefix_cache: bool = True):
+                 prefix_cache: bool = True,
+                 spill_bytes: int = 32 << 20):
         if role not in ROLES:
             raise ValueError(f"role must be one of {ROLES}, got {role!r}")
         self.name = str(name)
@@ -132,6 +133,12 @@ class WorkerRuntime:
         self._beat_thread = None
         self._t_last_step = time.monotonic()
 
+        # fleet KV-economy counters (ISSUE 12): ride every lease so the
+        # router's /metricsz can aggregate them fleet-wide
+        self.cache_counters: Dict[str, int] = {
+            "pull_serves": 0, "pull_stale": 0, "pull_installs": 0,
+            "crc_refusals": 0}
+
         if role in ("engine", "decode"):
             from .frontend import ServingEngine
             self.engine = ServingEngine(
@@ -140,9 +147,16 @@ class WorkerRuntime:
                 queue_capacity=(queue_capacity if role == "engine" else 1),
                 max_prefills_per_tick=max_prefills_per_tick,
                 prefill_bucket=prefill_bucket,
-                prefix_cache=(prefix_cache and role == "engine"))
+                prefix_cache=(prefix_cache and role == "engine"),
+                spill_bytes=(spill_bytes if role == "engine" else 0))
             self.pool = self.engine.pool
             self.scheduler = self.engine.scheduler
+            if self.engine.prefix_cache is not None:
+                # announce every cache lifecycle event over the wire:
+                # the router's global index mirrors this worker's trie
+                self.engine.on_cache_insert = self._announce_insert
+                self.engine.on_cache_evict = self._announce_evict
+                self.engine.on_spill_evict = self._announce_spill_evict
         else:  # prefill: staging pool + prefill programs ONLY
             from ..parallel.decode import _kv_heads
             from .cache_pool import CachePool
@@ -173,6 +187,73 @@ class WorkerRuntime:
             self._send("token", trace_id=trace_id, token=int(tok))
         return cb
 
+    # ---- fleet KV economy: cache announces + pull serving (ISSUE 12) ----
+    def _geom(self) -> Dict[str, Any]:
+        """Slab geometry the router needs to price a pull of this
+        worker's prefixes in token units (transfer_cost statics)."""
+        pool = self.engine.pool
+        return {"n_layers": pool.n_layers, "kv_dim": pool.kv_dim,
+                "dtype": str(pool.caches[0][0].dtype)}
+
+    def _announce_insert(self, entry) -> None:
+        try:
+            self._send("cache_announce", op="insert",
+                       prefix=[int(t) for t in entry.seq],
+                       length=int(entry.length), slot=int(entry.slot),
+                       geom=self._geom())
+        except Exception as e:  # noqa: BLE001 — the index is soft
+            # state; a failed announce costs a missed pull opportunity,
+            # never correctness
+            _flight.note("worker", event="announce_failed",
+                         worker=self.name, error=str(e))
+
+    def _announce_evict(self, entry, spilled: bool) -> None:
+        try:
+            self._send("cache_announce", op="evict",
+                       prefix=[int(t) for t in entry.seq],
+                       length=int(entry.length), spilled=bool(spilled))
+        except Exception as e:  # noqa: BLE001
+            _flight.note("worker", event="announce_failed",
+                         worker=self.name, error=str(e))
+
+    def _announce_spill_evict(self, seq, length) -> None:
+        try:
+            # tier-scoped: the device trie may hold this sequence HOT
+            # again (re-donated since the spill) — only a spill-tier
+            # index record may be dropped by a spill-store eviction
+            self._send("cache_announce", op="evict",
+                       prefix=[int(t) for t in seq], length=int(length),
+                       spilled=False, tier="spill")
+        except Exception as e:  # noqa: BLE001
+            _flight.note("worker", event="announce_failed",
+                         worker=self.name, error=str(e))
+
+    def _announce_snapshot(self) -> None:
+        """Full index rebuild, riding the ``hello`` re-admission
+        handshake: everything the router believed about this worker's
+        cache died with the fenced epoch — replace it with what this
+        incarnation actually holds (device trie + spill tier)."""
+        eng = self.engine
+        if eng is None or eng.prefix_cache is None:
+            return
+        entries = [
+            {"seq": [int(t) for t in e.seq], "length": int(e.length),
+             "tier": "hot"}
+            for e in eng.prefix_cache.entries()]
+        if eng.spill is not None:
+            hot = {tuple(e["seq"]) for e in entries}
+            entries += [
+                {"seq": [int(t) for t in seq], "length": int(length),
+                 "tier": "spill"}
+                for seq, length in eng.spill.entries()
+                if tuple(seq) not in hot]
+        try:
+            self._send("cache_announce", op="snapshot",
+                       entries=entries, geom=self._geom())
+        except Exception as e:  # noqa: BLE001
+            _flight.note("worker", event="announce_failed",
+                         worker=self.name, error=str(e))
+
     # ---- inbound control ----
     def _handle(self, msg: Dict[str, Any]) -> None:
         kind = msg.get("kind")
@@ -183,6 +264,10 @@ class WorkerRuntime:
             self.epoch = int(msg["epoch"])
             self.heart.epoch = self.epoch
             self.heart.beat(**self._lease_state())
+            # full cache-index rebuild rides the handshake (ISSUE 12):
+            # the router dropped every fenced-epoch entry at death,
+            # and this incarnation re-announces what it holds NOW
+            self._announce_snapshot()
             return
         if kind == "stop":
             self.finished = True
@@ -202,6 +287,10 @@ class WorkerRuntime:
             self._handle_submit(msg["req"])
         elif kind == "install":
             self._handle_install(msg)
+        elif kind == "cache_pull":
+            self._handle_cache_pull(msg)
+        elif kind == "install_prefix":
+            self._handle_install_prefix(msg)
         else:
             _flight.note("worker", event="unknown_ctl", worker=self.name,
                          msg_kind=kind)
@@ -277,6 +366,124 @@ class WorkerRuntime:
         except DcnLaneError as e:
             _flight.note("worker", event="gc_failed", tag=tag, lane=e.lane)
         self._send("install_ok", trace_id=trace_id)
+
+    def _handle_cache_pull(self, msg: Dict[str, Any]) -> None:
+        """Owner side of a remote prefix pull (ISSUE 12): pack the
+        requested prefix's K/V (pinned across the read — a concurrent
+        eviction can never free the slot mid-pack) and publish it on
+        the lane; the spill tier serves when the device trie already
+        scavenged the slot.  A claim that went fully stale since the
+        announce nacks ``stale`` — the router counts it and the request
+        degrades to re-prefill (the index is a hint, never truth)."""
+        from ..communicators.base import DcnLaneError
+
+        trace_id, tag = msg["trace_id"], msg["tag"]
+        seq = [int(t) for t in msg["prefix"]][: int(msg["length"])]
+        eng = self.engine
+        payload = None
+        if eng is not None and eng.prefix_cache is not None:
+            entry = eng.prefix_cache.pin_covering(seq)
+            if entry is not None:
+                try:
+                    payload = self.plane.pack(
+                        eng.pool, entry.slot, len(seq),
+                        meta={"seq": seq, "length": len(seq)})
+                finally:
+                    eng.prefix_cache.release(entry)
+            elif eng.spill is not None:
+                # demoted to the host tier: the spilled payload is
+                # already packed and CRC-stamped — serve it directly
+                payload = eng.spill.covering(seq)
+        if payload is None:
+            self.cache_counters["pull_stale"] += 1
+            _flight.note("worker", event="pull_stale", worker=self.name,
+                         trace_id=trace_id, prefix_len=len(seq))
+            self._send("cache_pull_nack", trace_id=trace_id, tag=tag,
+                       reason="stale")
+            return
+        try:
+            self.plane.lane_put(tag, payload)
+        except DcnLaneError as e:
+            _flight.note("worker", event="pull_publish_fault",
+                         worker=self.name, trace_id=trace_id,
+                         lane=e.lane)
+            self._send("cache_pull_nack", trace_id=trace_id, tag=tag,
+                       reason="publish_fault", lane=e.lane)
+            return
+        self.cache_counters["pull_serves"] += 1
+        self._send("cache_slab_ready", trace_id=trace_id, tag=tag,
+                   length=len(seq), pull=True)
+
+    def _handle_install_prefix(self, msg: Dict[str, Any]) -> None:
+        """Destination side of a remote prefix pull: land the slab into
+        a RESERVED slot through the pool-lifetime compiled inject
+        program (CRC verified inside ``unpack_into``) and donate it
+        straight into the local prefix cache, so the held-back submit
+        that follows gets a plain local hit.  The ONE caught
+        :class:`DcnLaneError` failure domain: reservation cancelled,
+        nack names the lane, the request re-prefills — never a wedge,
+        never a leaked slot."""
+        from ..communicators.base import DcnLaneError
+
+        trace_id, tag = msg["trace_id"], msg["tag"]
+        eng = self.engine
+        if eng is None or eng.prefix_cache is None:
+            self._send("prefix_nack", trace_id=trace_id, tag=tag,
+                       reason="no_cache")
+            return
+        pool = eng.pool
+        slot = pool.reserve()
+        if slot is None:
+            # scavenge an unpinned prefix slot like admission would —
+            # the pull replaces colder cache, it never starves decode
+            if eng.prefix_cache.evict_lru() is not None:
+                slot = pool.reserve()
+        if slot is None:
+            self._send("prefix_nack", trace_id=trace_id, tag=tag,
+                       reason="no_free_slot")
+            return
+        try:
+            payload = self.plane.lane_get(tag, self.lane_timeout_s)
+        except DcnLaneError as e:
+            pool.cancel_reservation(slot)
+            _flight.note("worker", event="prefix_install_fault",
+                         worker=self.name, trace_id=trace_id,
+                         lane=e.lane)
+            self._send("prefix_nack", trace_id=trace_id, tag=tag,
+                       reason="lane_fault", lane=e.lane)
+            return
+        try:
+            stats = self.plane.unpack_into(payload, pool, slot)
+        except ValueError as e:
+            # corrupt/foreign slab REFUSED (CRC/schema/shape): count,
+            # free the reservation, let the router fall back to a
+            # clean re-prefill — wrong KV is never served
+            pool.cancel_reservation(slot)
+            self.cache_counters["crc_refusals"] += 1
+            _flight.note("worker", event="prefix_crc_refused",
+                         worker=self.name, trace_id=trace_id,
+                         error=str(e))
+            self._send("prefix_nack", trace_id=trace_id, tag=tag,
+                       reason="crc")
+            return
+        meta = stats["meta"]
+        seq = [int(t) for t in meta.get("seq", [])][: stats["length"]]
+        pool.commit_reservation(slot)
+        entry = eng.prefix_cache.insert(seq, slot, len(seq))
+        if entry is not None:
+            pool.cache(slot)   # busy -> cached rc=0, announce fired
+        else:
+            # dedup: something local already covers it — the pull was
+            # redundant but the submit that follows still hits
+            pool.release(slot)
+        try:
+            self.plane.lane_delete(tag)
+        except DcnLaneError as e:
+            _flight.note("worker", event="gc_failed", tag=tag,
+                         lane=e.lane)
+        self.cache_counters["pull_installs"] += 1
+        self._send("prefix_installed", trace_id=trace_id,
+                   length=len(seq))
 
     # ---- role work ----
     def _prefill_round(self) -> int:
@@ -366,6 +573,8 @@ class WorkerRuntime:
                 "backlog_tokens": sum(r.prompt_len for r in queued),
                 "draining": self.draining,
                 "last_step_age_s": round(step_age, 4),
+                "cache": {"prefill_calls":
+                          int(self.dec_engine.prefill_calls)},
             }
         eng = self.engine
         queued = eng.scheduler.queued_requests()
@@ -378,6 +587,19 @@ class WorkerRuntime:
         # autoscaler's decode-side pressure signal, measured where it
         # exists (the engine) and read where the policy runs
         gap_p99 = eng._tick_gap_ms.percentile(99)
+        # KV-economy counters ride the lease (ISSUE 12): the router's
+        # /metricsz aggregates them fleet-wide without extra messages
+        cache = dict(self.cache_counters)
+        cache["prefill_calls"] = int(eng.engine.prefill_calls)
+        if eng.prefix_cache is not None:
+            cache["prefix_entries"] = eng.prefix_cache.n_entries
+            cache["prefix_hits"] = int(eng.prefix_cache.hits)
+        if eng.spill is not None:
+            sp = eng.spill
+            cache["spills"] = int(sp.spills)
+            cache["restores"] = int(sp.restores)
+            cache["crc_refusals"] = (cache.get("crc_refusals", 0)
+                                     + int(sp.crc_refusals))
         return {
             "queue_depth": len(queued),
             "queue_capacity": eng.scheduler.queue_capacity,
@@ -391,6 +613,7 @@ class WorkerRuntime:
             "last_step_age_s": round(step_age, 4),
             "tick_gap_p99_ms": (None if gap_p99 is None
                                 else round(gap_p99, 3)),
+            "cache": cache,
         }
 
     def start_heartbeat(self) -> None:

@@ -78,12 +78,19 @@ _SKIP = re.compile(
 #: a flap is an up-then-down inside one cooldown window (must stay 0),
 #: ttft is the priority tenant's held latency, and rung/degraded count
 #: how far down the overload ladder best-effort service was walked —
-#: more of any means the control loop got worse, ISSUE 11).
+#: more of any means the control loop got worse, ISSUE 11;
+#: prefill_calls/stale/spill/crc: the serving_kv_economy section's
+#: keys — fleet-wide prefill_calls per unique prefix is THE economy
+#: metric (1.0 is perfect reuse), stale fallbacks mean the global
+#: index over-promised, spills mean device cache pressure, and any
+#: CRC refusal means corrupt state reached a receiver — more of any
+#: means the KV economy got worse, ISSUE 12).
 _LOWER = re.compile(
     r"(time|_ms|ms_|/ms$|^ms$|latency|seconds|_s$|/s$|bytes|loss|"
     r"step_ms|gap|slowdown|imbalance|drift|anomal|dropped|findings|"
     r"rejected|shed|steps_to_recover|variance|requeue|detection|"
-    r"failover|fenced|redispatch|flap|ttft|rung|degraded)",
+    r"failover|fenced|redispatch|flap|ttft|rung|degraded|"
+    r"prefill_calls|stale|spill|crc)",
     re.IGNORECASE)
 
 

@@ -117,6 +117,12 @@ def explain(bundle: dict) -> dict:
             "queued": len(reqs.get("queued", [])),
             "running": len(reqs.get("running", [])),
             "recent": len(reqs.get("recent", []))}
+        if isinstance(serving.get("spill"), dict):
+            sp = serving["spill"]
+            out["spill_at_death"] = {
+                k: sp.get(k)
+                for k in ("entries", "bytes", "spills", "restores",
+                          "crc_refusals", "evictions")}
     train = providers.get("train")
     if isinstance(train, dict):
         out["train"] = {k: train.get(k)
@@ -161,8 +167,72 @@ def explain(bundle: dict) -> dict:
             "lane": extra.get("lane"),
             "trace_id": extra.get("trace_id"),
         }
+    # fleet KV economy (ISSUE 12): why a pull degraded, what spilled /
+    # restored, which announces were fenced away, and the cache-index
+    # view at death
+    rpf = extra.get("remote_pull_fault")
+    if isinstance(rpf, dict):
+        out["remote_pull_fault"] = {
+            k: rpf.get(k)
+            for k in ("trace_id", "reason", "detail", "worker", "lane",
+                      "owner", "dst", "prefix_len")}
+    pulls = [ev for ev in bundle.get("flight", [])
+             if ev.get("kind") == "fleet"
+             and str(ev.get("event", "")).startswith("remote_pull")]
+    if pulls:
+        by_event = {}
+        for ev in pulls:
+            by_event[ev["event"]] = by_event.get(ev["event"], 0) + 1
+        out["remote_pulls"] = {
+            "events": by_event,
+            "last": {k: pulls[-1].get(k)
+                     for k in ("event", "trace_id", "owner", "dst",
+                               "reason", "prefix_len", "pull_ms",
+                               "gain_tokens", "price_tokens")
+                     if pulls[-1].get(k) is not None},
+        }
+    spill_evs = [ev for ev in bundle.get("flight", [])
+                 if ev.get("kind") == "serving"
+                 and ev.get("event") in ("spill", "restore",
+                                         "spill_crc_refused")]
+    if spill_evs:
+        counts = {}
+        for ev in spill_evs:
+            counts[ev["event"]] = counts.get(ev["event"], 0) + 1
+        out["spill_tier"] = {
+            "events": counts,
+            "last": {k: spill_evs[-1].get(k)
+                     for k in ("event", "prefix_len", "bytes", "slot",
+                               "trace_id")
+                     if spill_evs[-1].get(k) is not None},
+        }
+    dropped_announces = [
+        ev for ev in bundle.get("flight", [])
+        if ev.get("kind") == "fleet" and ev.get("event") == "fenced_refusal"
+        and ev.get("msg_kind") == "cache_announce"]
+    if dropped_announces:
+        out.setdefault("spill_tier", {})
+        out["cache_announce_drops"] = {
+            "count": len(dropped_announces),
+            "workers": sorted({ev.get("worker")
+                               for ev in dropped_announces}),
+        }
     fleet = providers.get("fleet_health")
     if isinstance(fleet, dict):
+        ci = fleet.get("cache_index")
+        if isinstance(ci, dict):
+            out["cache_index"] = {
+                "entries": ci.get("entries"),
+                "per_worker": {w: len(v) for w, v in
+                               (ci.get("per_worker") or {}).items()},
+                "hits": ci.get("hits"),
+                "misses": ci.get("misses"),
+                "stale_fallbacks": ci.get("stale_fallbacks"),
+                "remote_pulls": ci.get("remote_pulls"),
+                "pending_pulls": ci.get("pending_pulls"),
+                "orphan_tags_swept": ci.get("orphan_tags_swept"),
+                "last_pull_fault": ci.get("last_pull_fault"),
+            }
         out["fleet_at_death"] = {
             "workers": {n: {"state": w.get("state"),
                             "lease_age_s": w.get("lease_age_s"),
@@ -293,6 +363,40 @@ def render_text(rep: dict) -> str:
         lines.append(
             f"  kv transfer fault: worker {kv.get('worker')} on lane "
             f"{kv.get('lane')} (trace {kv.get('trace_id')})")
+    if rep.get("remote_pull_fault"):
+        rp = rep["remote_pull_fault"]
+        lines.append(
+            f"  remote pull fault: owner {rp.get('owner')} -> "
+            f"{rp.get('dst')} (reason {rp.get('reason')}, lane "
+            f"{rp.get('lane')}, trace {rp.get('trace_id')}, prefix "
+            f"{rp.get('prefix_len')} tokens) — request fell back to "
+            f"re-prefill")
+    if rep.get("remote_pulls"):
+        rp = rep["remote_pulls"]
+        lines.append(
+            f"  remote pulls: {json.dumps(rp.get('events'))}"
+            + (f"; last {json.dumps(rp['last'])}" if rp.get("last")
+               else ""))
+    if rep.get("spill_tier"):
+        sp = rep["spill_tier"]
+        lines.append(f"  spill tier events: {json.dumps(sp.get('events'))}")
+    if rep.get("spill_at_death"):
+        lines.append(
+            f"  spill store at death: {json.dumps(rep['spill_at_death'])}")
+    if rep.get("cache_announce_drops"):
+        ca = rep["cache_announce_drops"]
+        lines.append(
+            f"  fenced cache_announce drops: {ca.get('count')} "
+            f"(workers {ca.get('workers')})")
+    if rep.get("cache_index"):
+        ci = rep["cache_index"]
+        lines.append(
+            f"  fleet cache index: {ci.get('entries')} entries over "
+            f"{json.dumps(ci.get('per_worker'))} — hits "
+            f"{ci.get('hits')}, misses {ci.get('misses')}, remote "
+            f"pulls {ci.get('remote_pulls')}, stale fallbacks "
+            f"{json.dumps(ci.get('stale_fallbacks'))}, orphan tags "
+            f"swept {ci.get('orphan_tags_swept')}")
     if rep.get("fleet_at_death"):
         fl = rep["fleet_at_death"]
         lines.append(f"  fleet at death: {json.dumps(fl['workers'])}")

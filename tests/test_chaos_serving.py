@@ -376,3 +376,83 @@ def test_serve_cli_fleet_procs_subprocess(tmp_path):
     assert sum(r["status"] == "done" for r in summary["requests"]) >= 4
     assert summary["metrics"]["fleet/shed_rate"] == 0.0
     assert summary["goodput"]["buckets_s"]["supervise"] >= 0.0
+
+
+@pytest.mark.slow
+def test_sigkill_slab_owner_mid_remote_pull_real_processes(devices,
+                                                           tmp_path):
+    """The ISSUE 12 chaos acceptance against REAL processes: the slab-
+    owning worker is frozen (SIGSTOP) so a planned remote pull cannot
+    complete, then SIGKILL'd mid-pull — the puller's request completes
+    TOKEN-EXACT via local re-prefill, the fallback is counted, a
+    ``remote_pull_fault`` bundle names the owner and its lane, and no
+    process or thread hangs (every wait is deadline-bounded)."""
+    import jax
+
+    from chainermn_tpu.parallel import init_tp_transformer_lm
+    from chainermn_tpu.serving.fleet import build_proc_fleet
+
+    params = init_tp_transformer_lm(
+        jax.random.PRNGKey(0), VOCAB, D, HEADS, LAYERS, max_len=64,
+        pos_impl="rope")
+    bundles = str(tmp_path / "bundles")
+    router = build_proc_fleet(
+        params, {"engine": 2}, str(tmp_path / "lanes"),
+        head_dim=HEAD_DIM, beat_interval_s=0.1, miss_beats=3,
+        bundle_dir=bundles, env=_worker_env(),
+        worker_kwargs=dict(n_slots=3, max_total=24, queue_capacity=16))
+    oracle = _oracle_fn(params, devices, 6)
+    try:
+        _pump_until(router,
+                    lambda: all(w.state == "live"
+                                for w in router.workers.values()),
+                    timeout=120, what="worker boot leases")
+        prompt = (np.arange(10) % VOCAB).astype(np.int32)
+        leader = router.submit(prompt, 6)
+        _pump_until(router, lambda: leader.status == "done",
+                    timeout=120, what="leader prefill")
+        assert leader.tokens == oracle(prompt)
+        _pump_until(router,
+                    lambda: router.cache_index.n_entries >= 1,
+                    timeout=60, what="cache announce in the index")
+        owner = router.cache_index.workers()[0]
+        victim = router.workers[owner]
+
+        # freeze the owner so the pull can NEVER complete, then plan it
+        os.kill(victim.proc.pid, signal.SIGSTOP)
+        h = router.submit(prompt, 6)
+        with router._lock:
+            entry = router._inflight[h.trace_id]
+            assert entry.get("pull"), "no pull planned — premise broke"
+            assert entry["pull"]["owner"] == owner
+        os.kill(victim.proc.pid, signal.SIGKILL)     # mid-pull death
+        _pump_until(router, lambda: h.status in ("done", "evicted"),
+                    timeout=120, what="fallback re-prefill")
+        assert h.status == "done"
+        assert h.tokens == oracle(prompt)            # token-exact
+        m = router.metrics()
+        assert m["fleet/cache/stale_fallbacks/owner_lost"] == 1
+        assert router.workers[owner].state == "dead"
+        assert router.cache_index.entries_for(owner) == {}
+        from chainermn_tpu.observability.flight import (find_bundles,
+                                                        read_bundle)
+        rp_bundles = [b for b in find_bundles(bundles)
+                      if "remote_pull_fault" in os.path.basename(b)]
+        assert rp_bundles, "no remote_pull_fault bundle dumped"
+        rpf = (read_bundle(rp_bundles[-1])["manifest"]["extra"]
+               or {})["remote_pull_fault"]
+        assert rpf["owner"] == owner and owner in rpf["lane"]
+        out = subprocess.run(
+            [sys.executable,
+             os.path.join(ROOT, "scripts", "explain_bundle.py"),
+             rp_bundles[-1], "--json"],
+            capture_output=True, text=True, timeout=60)
+        assert out.returncode == 0, out.stderr
+        assert json.loads(out.stdout)["remote_pull_fault"]["owner"] \
+            == owner
+    finally:
+        codes = router.shutdown()
+        router.close()
+    # the survivor exits cleanly; the SIGKILL'd owner reports -9
+    assert codes.get(owner) == -signal.SIGKILL
+    assert all(c == 0 for w, c in codes.items() if w != owner), codes
