@@ -1649,6 +1649,155 @@ def bench_elastic_resume():
     return out
 
 
+def bench_train_chaos():
+    """Self-healing training gang (ISSUE 13): what a mid-training rank
+    death costs with live shrink vs the checkpoint-restart fallback.
+
+    An n=4 gang runs lockstep collectives over the lane side channel
+    with per-rank heartbeat leases; member 2 dies (stops beating and
+    participating — the in-process stand-in for SIGKILL; the REAL
+    multi-process SIGKILL is tests/test_chaos_gang.py's job) right
+    before a step's allreduce:
+
+    * ``detection_ms`` — wall time from death to the survivors'
+      ``RankLostError`` NAMING the rank, vs ``detection_window_ms`` =
+      beat × (miss_beats + 1).
+    * ``consensus_wall_ms`` / ``reshard_wall_ms`` / ``reconfig_wall_ms``
+      — the membership agreement, the ``reshard_host`` re-partition of
+      the n=4 momentum blocks onto n=3, and the whole heal() wall.
+    * ``steps_lost_live_shrink`` — completed steps re-executed after the
+      live shrink (MUST stay 0: survivors resume from the last completed
+      step off the shard leases, no checkpoint read) vs
+      ``steps_lost_checkpoint_restart`` — what the same death costs
+      through the PR 8 path at the periodic cadence (here: save every
+      5, death after step 8 completes → 3 steps replayed).
+    * ``step_collective_ms`` — steady-state per-step side-channel wall,
+      so the health plane's own overhead rides the gate too.
+
+    Every-backend contract (pure host machinery); ``detection``/
+    ``consensus``/``reconfig``/``reshard``/``steps_lost`` keys gate
+    lower-is-better in bench_history.jsonl.
+    """
+    import shutil
+    import tempfile
+    import threading
+
+    import numpy as np
+
+    from chainermn_tpu.extensions.gang import SelfHealingGang
+    from chainermn_tpu.health import RankLostError, detection_window_s
+    from chainermn_tpu.parallel.reshard import reshard_host
+    from chainermn_tpu.serving.lanes import FileLaneStore
+
+    N, VICTIM, KILL_AT, TOTAL, M = 4, 2, 9, 12, 24
+    BEAT, MISS, CKPT_EVERY = 0.02, 3, 5
+    tmp = tempfile.mkdtemp(prefix="bench-train-chaos-")
+    try:
+        store = FileLaneStore(tmp)
+        gangs = [SelfHealingGang(store, rank=i, world=N, name="bench",
+                                 beat_interval_s=BEAT, miss_beats=MISS,
+                                 min_world=2, register_provider=False)
+                 for i in range(N)]
+        for g in gangs:
+            g.start()
+
+        t_kill = [None]
+        res = {}
+        logical = np.arange(M, dtype=np.float64)
+
+        def member(i):
+            g = gangs[i]
+            block = logical.reshape(N, -1)[i].copy()
+            step_walls, detect_ms, rc_info = [], None, None
+            it = 0
+            while it < TOTAL:
+                if i == VICTIM and it == KILL_AT:
+                    t_kill[0] = time.perf_counter()
+                    g.stop(release=False)  # lease goes stale: "SIGKILL"
+                    res[i] = {"died_at": it}
+                    return
+                try:
+                    t0 = time.perf_counter()
+                    total = g.allreduce(1.0, label=f"s{it}")
+                    step_walls.append(time.perf_counter() - t0)
+                    assert total == float(g.world), total
+                    block = block + 1.0
+                    g.publish_shard(it, block)
+                    it += 1
+                except RankLostError as e:
+                    # t_kill can still be None on a SPURIOUS pre-kill
+                    # detection (in-process beat threads starved past
+                    # the tight 80ms window under CI load) — record no
+                    # latency rather than crashing the section
+                    detect_ms = (None if t_kill[0] is None else
+                                 (time.perf_counter() - t_kill[0]) * 1e3)
+
+                    def repartition(rc):
+                        order = rc.old_members
+                        shards = [{"m": rc.shards[m]["payload"]}
+                                  for m in order]
+                        return reshard_host(shards, {"m": 0}, {"m": 0},
+                                            rc.new_world)
+
+                    rc = g.heal(repartition=repartition)
+                    assert rc.resume_iteration() == it - 1, (
+                        rc.resume_iteration(), it)
+                    block = rc.repartitioned[rc.new_rank]["m"]
+                    rc_info = rc.summary()
+                    rc_info["missing"] = sorted(e.ranks)
+            # exactness: the logical array survived the shrink
+            res[i] = {"detect_ms": detect_ms, "rc": rc_info,
+                      "block": block,
+                      "step_ms": sorted(step_walls)[len(step_walls) // 2]
+                      * 1e3}
+
+        threads = [threading.Thread(target=member, args=(i,))
+                   for i in range(N)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert all(not t.is_alive() for t in threads), "gang bench hung"
+        survivors = [res[i] for i in range(N) if i != VICTIM]
+        assert all(s.get("rc") for s in survivors), res
+        full = np.concatenate([s["block"] for s in survivors])
+        np.testing.assert_array_equal(full, logical + TOTAL)
+
+        rc = survivors[0]["rc"]
+        last_completed = KILL_AT - 1
+        dms = [s["detect_ms"] for s in survivors
+               if s.get("detect_ms") is not None]
+        out = {
+            "world": N,
+            "detection_ms": round(min(dms), 1) if dms else None,
+            "detection_window_ms": round(
+                detection_window_s(BEAT, MISS) * 1e3, 1),
+            "consensus_wall_ms": rc["consensus_wall_ms"],
+            "reshard_wall_ms": rc["reshard_wall_ms"],
+            "reconfig_wall_ms": round(
+                rc["consensus_wall_ms"] + (rc["reshard_wall_ms"] or 0.0),
+                1),
+            "step_collective_ms": round(
+                max(s["step_ms"] for s in survivors), 2),
+            # live shrink resumes at the failed step: completed steps
+            # replayed == 0; the checkpoint fallback replays back to the
+            # last periodic generation
+            "steps_lost_live_shrink": last_completed
+            - rc["resume_iteration"],
+            "steps_lost_checkpoint_restart": last_completed
+            - (last_completed // CKPT_EVERY) * CKPT_EVERY,
+            "fenced_refusals": sum(
+                gangs[i].fenced_refusals().get("lease", 0)
+                for i in range(N) if i != VICTIM),
+        }
+        for i in range(N):
+            if i != VICTIM:
+                gangs[i].stop()
+        return out
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def scaling_worker(n, grad_dtype=None, double_buffering=False):
     """Subprocess body: weak-scaling point on an n-device virtual CPU mesh.
 
@@ -2139,6 +2288,7 @@ def main():
         "serving_chaos": None,
         "serving_autoscale": None,
         "serving_kv_economy": None,
+        "train_chaos": None,
         "data_path": None,
         "long_context": None,
         "projected_scaling": projected,
@@ -2198,6 +2348,10 @@ def main():
             "kv_economy_prefills_per_prefix": g(
                 result, "serving_kv_economy",
                 "prefill_calls_per_unique_prefix"),
+            "train_chaos_detection_ms": g(result, "train_chaos",
+                                          "detection_ms"),
+            "train_chaos_reconfig_ms": g(result, "train_chaos",
+                                         "reconfig_wall_ms"),
             "flash_s8192_mfu": g(result, "long_context",
                                  "flash_fwd_bwd_S8192", "attn_mfu"),
             "flash_s16384_mfu": g(result, "long_context",
@@ -2405,6 +2559,24 @@ def main():
             emit()
     else:
         print("bench: over budget — serving_kv_economy section skipped",
+              file=sys.stderr)
+
+    # --- train chaos: rank death -> live shrink cost (ISSUE 13) ------------
+    # Every-backend contract (pure host machinery); detection/consensus/
+    # reconfig/reshard/steps_lost keys gate lower-is-better in
+    # bench_history.jsonl — the acceptance bound is
+    # steps_lost_live_shrink == 0 (checkpoint-free resume from the
+    # failed step) with detection_ms tracking detection_window_ms.
+    if not over_budget():
+        try:
+            result["train_chaos"] = bench_train_chaos()
+            emit("train_chaos")
+        except Exception as e:
+            print(f"bench: train_chaos section failed: {e!r}",
+                  file=sys.stderr)
+            emit()
+    else:
+        print("bench: over budget — train_chaos section skipped",
               file=sys.stderr)
 
     # --- elastic resume: checkpoint/reshard/preemption cost (ISSUE 8) ------

@@ -135,8 +135,15 @@ def classify_lane_error(e: BaseException) -> str:
 
 
 #: Test/chaos fault injection: ``fn(lane, attempt)`` raising to simulate a
-#: fault, or None.  ``CHAINERMN_TPU_LANE_FAULT=<lane_substr>:<transient|
-#: permanent>:<count>`` arms an env-driven injector for subprocess gangs.
+#: fault, or None.  ``CHAINERMN_TPU_LANE_FAULT=<lane_pattern>:<transient|
+#: permanent>:<count>[:after=N]`` arms an env-driven injector for
+#: subprocess gangs.  ``lane_pattern`` is a substring match, or an
+#: ``fnmatch`` glob when it contains ``*``/``?``/``[`` (matched against
+#: the FULL lane name); ``after=N`` lets the first N matching calls pass
+#: clean before the fault budget starts burning — per-op targeting, so a
+#: chaos drill can kill a SPECIFIC collective step deterministically
+#: ("gang/*/x/step7/*:permanent:1:after=0") instead of whichever lane op
+#: happens to run first (ISSUE 13).
 _FAULT_INJECTOR: Optional[Callable[[str, int], None]] = None
 _ENV_FAULT: Optional[Dict[str, Any]] = None
 
@@ -146,15 +153,32 @@ def set_lane_fault_injector(fn: Optional[Callable[[str, int], None]]) -> None:
     _FAULT_INJECTOR = fn
 
 
+def _lane_matches(pattern: str, lane: str) -> bool:
+    """Substring match, upgraded to an fnmatch glob over the FULL lane
+    name when the pattern carries glob metacharacters."""
+    if any(c in pattern for c in "*?["):
+        import fnmatch
+        return fnmatch.fnmatchcase(lane, pattern)
+    return pattern in lane
+
+
 def _env_fault_state() -> Optional[Dict[str, Any]]:
     global _ENV_FAULT
     spec = os.environ.get("CHAINERMN_TPU_LANE_FAULT")
     if not spec:
         return None
     if _ENV_FAULT is None or _ENV_FAULT.get("spec") != spec:
-        lane_substr, kind, count = spec.rsplit(":", 2)
-        _ENV_FAULT = {"spec": spec, "lane": lane_substr, "kind": kind,
-                      "remaining": int(count)}
+        body, skip = spec, 0
+        if ":after=" in spec:
+            body, after = spec.rsplit(":after=", 1)
+            skip = int(after)
+        lane_pattern, kind, count = body.rsplit(":", 2)
+        if kind not in ("transient", "permanent"):
+            raise ValueError(
+                f"CHAINERMN_TPU_LANE_FAULT kind must be transient|"
+                f"permanent, got {kind!r} in {spec!r}")
+        _ENV_FAULT = {"spec": spec, "lane": lane_pattern, "kind": kind,
+                      "remaining": int(count), "skip": skip}
     return _ENV_FAULT
 
 
@@ -162,7 +186,10 @@ def _maybe_inject_fault(lane: str, attempt: int) -> None:
     if _FAULT_INJECTOR is not None:
         _FAULT_INJECTOR(lane, attempt)
     st = _env_fault_state()
-    if st and st["remaining"] > 0 and st["lane"] in lane:
+    if st and st["remaining"] > 0 and _lane_matches(st["lane"], lane):
+        if st.get("skip", 0) > 0:
+            st["skip"] -= 1   # fire-after-N: this matching call passes
+            return
         st["remaining"] -= 1
         if st["kind"] == "transient":
             raise RuntimeError(
@@ -357,6 +384,16 @@ class CommunicatorBase:
             from ..serving.transfer import InProcessLaneStore
             store = self._kv_lane_store = InProcessLaneStore()
         return store
+
+    def gang_lease_store(self):
+        """The rank health plane's store (ISSUE 13): this communicator's
+        KV side channel adapted to the lease-store face —
+        ``SelfHealingGang`` publishes heartbeat leases, consensus
+        proposals, and shard leases through it.  Absent tags surface as
+        ``TimeoutError`` (the ``FileLaneStore`` contract) so non-blocking
+        lease polls read absence as absence, not as a retryable fault."""
+        from ..health import KvLeaseStore
+        return KvLeaseStore(self.kv_lane_transport())
 
     def allreduce_obj(self, obj: Any, op: Callable = None) -> Any:
         raise NotImplementedError

@@ -11,6 +11,7 @@ from .multi_node_snapshot import (  # noqa: F401
     MultiNodeSnapshot,
     multi_node_snapshot,
 )
+from .gang import GangReconfig, SelfHealingGang  # noqa: F401
 from .observation_aggregator import (  # noqa: F401
     ObservationAggregator,
     aggregate_observations,
@@ -19,6 +20,8 @@ from .preemption import PreemptionExit, PreemptionHandler  # noqa: F401
 from .watchdog import Watchdog  # noqa: F401
 
 __all__ = [
+    "GangReconfig",
+    "SelfHealingGang",
     "AllreducePersistent",
     "allreduce_persistent",
     "MANIFEST_SCHEMA",

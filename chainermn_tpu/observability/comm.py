@@ -41,6 +41,9 @@ from typing import Any, Dict, Optional
 import numpy as np
 
 from . import trace
+# stdlib-only at module level (its lane/store imports are lazy), so this
+# costs nothing and keeps the guarded hot path at ONE global read/call
+from ..health import collective_guard as _collective_guard
 
 
 def _as_dtype(dt) -> np.dtype:
@@ -292,10 +295,26 @@ def collective(op: str, axis, x, thunk, wire_dtype=None):
     accounting.  The in-jit face's single entry point: bytes/dtype come
     from ``x``'s leaves; host latency is recorded only for eager calls;
     ``wire_dtype`` overrides the byte count for compressed-wire ops
-    (quantized ring: int8 payload regardless of ``x.dtype``)."""
+    (quantized ring: int8 payload regardless of ``x.dtype``).
+
+    When a :class:`~chainermn_tpu.health.CollectiveGuard` is installed
+    (``health.set_collective_guard`` — the training gang's collective
+    watchdog, ISSUE 13), every EAGER call is bracketed by
+    ``guard.enter/exit``: a call that outlives the guard window is
+    aborted loudly with the missing rank(s) NAMED from the lease table
+    instead of hanging anonymously.  Trace-time (in-jit) calls complete
+    at trace and are not guarded; guarding works with tracing disabled.
+    """
     tr = trace.get_tracer()
+    guard = _collective_guard()
     if not tr.enabled:
-        return thunk()
+        if guard is None:
+            return thunk()
+        tok = guard.enter(op)
+        try:
+            return thunk()
+        finally:
+            guard.exit(tok)
     nbytes, dtype, n_elems, in_jit = payload_info(x)
     if wire_dtype is not None:
         wd = _as_dtype(wire_dtype)
@@ -305,9 +324,14 @@ def collective(op: str, axis, x, thunk, wire_dtype=None):
         out = thunk()
         _ACCOUNTANT.record(op, axis, nbytes, dtype, in_jit=True)
         return out
+    tok = guard.enter(op) if guard is not None else None
     t0 = time.perf_counter()
-    with tr.span(f"comm/{op}", cat="comm", axis=str(axis), bytes=nbytes):
-        out = thunk()
+    try:
+        with tr.span(f"comm/{op}", cat="comm", axis=str(axis), bytes=nbytes):
+            out = thunk()
+    finally:
+        if tok is not None:
+            guard.exit(tok)
     _ACCOUNTANT.record(op, axis, nbytes, dtype, in_jit=False,
                        latency_s=time.perf_counter() - t0)
     return out
@@ -328,28 +352,49 @@ def accounted_method(op: str):
     levels wrapped by ``__init_subclass__``) books one logical
     collective once, and helpers implemented in terms of other wrapped
     collectives (``multi_node_mean_grad`` → ``allreduce``) book under
-    the caller's name rather than double."""
+    the caller's name rather than double.
+
+    The installed :class:`~chainermn_tpu.health.CollectiveGuard` (if
+    any) brackets the OUTERMOST call too — the communicator hot path's
+    bounded-timeout watchdog (ISSUE 13), active even with tracing off.
+    """
     import functools
 
     def deco(fn):
         @functools.wraps(fn)
         def wrapper(self, x, *args, **kwargs):
             tr = trace.get_tracer()
-            if not tr.enabled or getattr(_EAGER_DEPTH, "d", 0):
-                return fn(self, x, *args, **kwargs)
-            nbytes, dtype, _, _ = payload_info(x)
-            axis = getattr(self, "axis_name", "world")
-            _EAGER_DEPTH.d = 1
-            t0 = time.perf_counter()
+            nested = getattr(_EAGER_DEPTH, "d", 0)
+            guard = None if nested else _collective_guard()
+            tok = guard.enter(op) if guard is not None else None
             try:
-                with tr.span(f"comm/{op}", cat="comm", axis=str(axis),
-                             bytes=nbytes):
-                    out = fn(self, x, *args, **kwargs)
+                if not tr.enabled or nested:
+                    if guard is None:
+                        return fn(self, x, *args, **kwargs)
+                    # outermost-with-guard, tracing off: still mark the
+                    # depth so a delegating helper (multi_node_mean_grad
+                    # -> allreduce) cannot double-enter the guard
+                    _EAGER_DEPTH.d = 1
+                    try:
+                        return fn(self, x, *args, **kwargs)
+                    finally:
+                        _EAGER_DEPTH.d = 0
+                nbytes, dtype, _, _ = payload_info(x)
+                axis = getattr(self, "axis_name", "world")
+                _EAGER_DEPTH.d = 1
+                t0 = time.perf_counter()
+                try:
+                    with tr.span(f"comm/{op}", cat="comm", axis=str(axis),
+                                 bytes=nbytes):
+                        out = fn(self, x, *args, **kwargs)
+                finally:
+                    _EAGER_DEPTH.d = 0
+                _ACCOUNTANT.record(op, axis, nbytes, dtype, in_jit=False,
+                                   latency_s=time.perf_counter() - t0)
+                return out
             finally:
-                _EAGER_DEPTH.d = 0
-            _ACCOUNTANT.record(op, axis, nbytes, dtype, in_jit=False,
-                               latency_s=time.perf_counter() - t0)
-            return out
+                if tok is not None:
+                    guard.exit(tok)
         wrapper._obs_wrapped = True
         return wrapper
     return deco

@@ -1,275 +1,34 @@
 """Heartbeat/lease health plane for the cross-process serving fleet.
 
-ISSUE 10's supervision layer, jax-free and fuzzable standalone:
-
-* **Leases** — each worker publishes a heartbeat lease (role, epoch,
-  seq, queue depth, free slots, backlog, draining flag) under its OWN
-  lane tag (``lease/<worker>``), overwritten every beat.  That is the
-  ``allgather_obj_eventual`` pattern applied to liveness: a bounded
-  per-publisher side channel, deliberately NOT a gang collective — a
-  dead worker is simply ABSENT (its lease stops refreshing), it can
-  never wedge the readers.
-* **Detection-window math** — the supervisor clocks a lease by when IT
-  saw a new sequence number (receiver-side monotonic time, so worker
-  clock skew is irrelevant).  A worker beating every ``beat_interval_s``
-  that misses ``miss_beats`` consecutive beats is declared dead after
-  at most ``beat_interval_s * (miss_beats + 1)`` seconds — the ``+1``
-  covers the worst-case phase offset between the last accepted beat and
-  the first missed one (docs/ROBUSTNESS.md "Serving failure domains").
-* **Epoch fencing** — every worker admission mints a monotonic epoch;
-  marking a worker dead FENCES its epoch, and every lease, token,
-  result, or slab stamped with a fenced epoch is refused and counted
-  (:class:`EpochFence`).  A paused-then-resumed zombie can therefore
-  never land anything: its writes carry the old epoch, and re-admission
-  always mints a new one.
-* **Circuit breaker** — re-admission of a flapping worker is governed
-  by :class:`CircuitBreaker`: each failure doubles the hold-off
-  (exponential backoff, capped), and a bounded retry budget turns a
-  serial flapper into a permanent removal instead of an infinite
-  flap-readmit loop.
+ISSUE 10 built these primitives for the serving fleet; ISSUE 13
+promoted them into the transport-agnostic core
+:mod:`chainermn_tpu.health` so the TRAINING gang's self-healing plane
+(``extensions/gang.py``) runs the exact same lease/epoch/breaker
+machinery.  This module re-exports the full original surface — every
+existing import path (fleet, workers, tests, analysis entry points)
+keeps working unchanged; see the core module for the semantics
+(detection-window math, receiver-side clocking, epoch fencing, the
+circuit breaker) and docs/ROBUSTNESS.md "Serving failure domains".
 """
 
 from __future__ import annotations
 
-import os
-import pickle
-import threading
-import time
-from typing import Any, Dict, Optional
+from ..health import (  # noqa: F401
+    LEASE_SCHEMA,
+    CircuitBreaker,
+    EpochFence,
+    HeartbeatPublisher,
+    LeaseTable,
+    detection_window_s,
+    make_lease,
+)
 
-#: Wire schema of one published lease.
-LEASE_SCHEMA = "chainermn_tpu.lease.v1"
-
-
-def detection_window_s(beat_interval_s: float, miss_beats: int) -> float:
-    """Worst-case seconds from death to detection: ``miss_beats``
-    missed beats plus one interval of phase offset (the worker may die
-    immediately after a beat the supervisor just accepted)."""
-    return float(beat_interval_s) * (int(miss_beats) + 1)
-
-
-def make_lease(worker: str, role: str, epoch: int, seq: int,
-               **state) -> Dict[str, Any]:
-    """One heartbeat lease payload (plain dict: the wire shape)."""
-    lease = {
-        "schema": LEASE_SCHEMA,
-        "worker": str(worker),
-        "role": str(role),
-        "epoch": int(epoch),
-        "seq": int(seq),
-        "pid": os.getpid(),
-        "t_wall": time.time(),
-    }
-    lease.update(state)
-    return lease
-
-
-class HeartbeatPublisher:
-    """Worker-side half: publish this worker's lease on the lane store
-    every ``beat_interval_s`` (callers invoke :meth:`maybe_beat` from
-    their loop — a wedged loop then misses leases, which is exactly the
-    liveness semantics the supervisor wants to observe).
-
-    Thread-safe: a worker may beat from both its step loop and a side
-    heartbeat thread, so seq minting + the put serialize under a lock
-    (concurrent unlocked beats could publish duplicate/out-of-order
-    seqs and regress lease contents).  :meth:`release` latches the
-    publisher closed under the same lock, so a racing beat can never
-    resurrect the lease of a worker that just drained."""
-
-    def __init__(self, store, worker: str, role: str, epoch: int,
-                 beat_interval_s: float = 0.05, lane_config=None):
-        self.store = store
-        self.worker = str(worker)
-        self.role = str(role)
-        self.epoch = int(epoch)
-        self.beat_interval_s = float(beat_interval_s)
-        self.lane_config = lane_config
-        self.seq = 0
-        self._last_beat = 0.0
-        self._lock = threading.Lock()
-        self._released = False
-
-    def beat(self, **state) -> Optional[Dict[str, Any]]:
-        """Publish one lease; returns it (None once released)."""
-        from ..communicators.base import lane_call
-
-        with self._lock:
-            if self._released:
-                return None
-            self.seq += 1
-            lease = make_lease(self.worker, self.role, self.epoch,
-                               self.seq, **state)
-            payload = pickle.dumps(lease,
-                                   protocol=pickle.HIGHEST_PROTOCOL)
-            lane_call(f"health/{self.worker}/beat",
-                      lambda: self.store.put(f"lease/{self.worker}",
-                                             payload),
-                      self.lane_config)
-            self._last_beat = time.monotonic()
-            return lease
-
-    def maybe_beat(self, **state) -> Optional[Dict[str, Any]]:
-        """Publish iff a beat interval elapsed since the last one."""
-        if time.monotonic() - self._last_beat >= self.beat_interval_s:
-            return self.beat(**state)
-        return None
-
-    def release(self) -> None:
-        """Graceful exit (drain): delete this worker's lease so the
-        supervisor sees an explicit departure, not a missed window.
-        Latches the publisher: later beats are refused."""
-        from ..communicators.base import lane_call
-
-        with self._lock:
-            self._released = True
-            lane_call(f"health/{self.worker}/release",
-                      lambda: self.store.delete(f"lease/{self.worker}"),
-                      self.lane_config)
-
-
-class LeaseTable:
-    """Supervisor-side half: read leases and clock them by RECEIVER
-    monotonic time — ``age_s`` is seconds since this process last saw a
-    NEW sequence number, immune to cross-process clock skew."""
-
-    def __init__(self, store, lane_config=None):
-        self.store = store
-        self.lane_config = lane_config
-        # worker -> (last seen lease dict, t_seen of last NEW seq)
-        self._seen: Dict[str, Any] = {}
-
-    def read(self, worker: str) -> Optional[Dict[str, Any]]:
-        """Latest lease for ``worker`` (schema-checked), or None when
-        the worker never published / released its lease."""
-        from .lanes import lane_try_get
-
-        payload = lane_try_get(self.store, f"health/{worker}/read",
-                               f"lease/{worker}", self.lane_config)
-        if payload is None:
-            return None
-        lease = pickle.loads(payload)
-        if lease.get("schema") != LEASE_SCHEMA:
-            raise ValueError(
-                f"refusing lease with schema {lease.get('schema')!r} "
-                f"for worker {worker!r} (this supervisor speaks "
-                f"{LEASE_SCHEMA})")
-        prev = self._seen.get(worker)
-        if prev is None or lease["seq"] != prev[0]["seq"]:
-            self._seen[worker] = (lease, time.monotonic())
-        return self._seen[worker][0]
-
-    def age_s(self, worker: str) -> Optional[float]:
-        """Seconds since the last NEW lease seq from ``worker`` was
-        observed, or None before any lease arrived."""
-        self.read(worker)
-        prev = self._seen.get(worker)
-        if prev is None:
-            return None
-        return time.monotonic() - prev[1]
-
-    def forget(self, worker: str) -> None:
-        self._seen.pop(worker, None)
-
-
-class EpochFence:
-    """Monotonic per-worker epochs + the fence refusing stale writes.
-
-    The router mints ``new_epoch(worker)`` at every (re-)admission and
-    ``fence(worker)`` on death.  Receivers gate every inbound artifact
-    with :meth:`admit` — a stale-epoch lease/token/result/slab is
-    refused AND counted per kind, which is the zombie-fencing
-    acceptance evidence (ISSUE 10)."""
-
-    def __init__(self):
-        self._lock = threading.Lock()
-        self._epoch: Dict[str, int] = {}     # worker -> current epoch
-        self._fenced: Dict[str, bool] = {}
-        self.refusals: Dict[str, int] = {}   # kind -> refused count
-
-    def new_epoch(self, worker: str) -> int:
-        with self._lock:
-            e = self._epoch.get(worker, 0) + 1
-            self._epoch[worker] = e
-            self._fenced[worker] = False
-            return e
-
-    def fence(self, worker: str) -> None:
-        with self._lock:
-            self._fenced[worker] = True
-
-    def current(self, worker: str) -> Optional[int]:
-        with self._lock:
-            return self._epoch.get(worker)
-
-    def is_fenced(self, worker: str) -> bool:
-        with self._lock:
-            return bool(self._fenced.get(worker, False))
-
-    def admit(self, worker: str, epoch, kind: str) -> bool:
-        """Whether an artifact stamped ``epoch`` from ``worker`` may
-        land.  Refusals (stale epoch, or the worker's current epoch is
-        fenced) are counted under ``kind``."""
-        with self._lock:
-            cur = self._epoch.get(worker)
-            ok = (cur is not None and int(epoch) == cur
-                  and not self._fenced.get(worker, False))
-            if not ok:
-                self.refusals[kind] = self.refusals.get(kind, 0) + 1
-            return ok
-
-    def refusal_counts(self) -> Dict[str, int]:
-        with self._lock:
-            return dict(self.refusals)
-
-
-class CircuitBreaker:
-    """Per-worker re-admission governor: retry budget + exponential
-    backoff.  ``record_failure`` opens the circuit for ``backoff_base_s
-    * 2^(failures-1)`` (capped at ``backoff_max_s``); :meth:`allow`
-    half-opens it after the hold-off; ``record_success`` closes it and
-    refunds the budget.  Past ``max_failures`` consecutive failures the
-    circuit opens PERMANENTLY — a serial flapper is removed from the
-    fleet rather than re-admitted forever."""
-
-    def __init__(self, max_failures: int = 4, backoff_base_s: float = 0.5,
-                 backoff_max_s: float = 30.0,
-                 clock=time.monotonic):
-        self.max_failures = int(max_failures)
-        self.backoff_base_s = float(backoff_base_s)
-        self.backoff_max_s = float(backoff_max_s)
-        self._clock = clock
-        self.failures = 0
-        self._open_until: Optional[float] = None
-        self.permanently_open = False
-
-    def record_failure(self) -> None:
-        self.failures += 1
-        if self.failures >= self.max_failures:
-            self.permanently_open = True
-            self._open_until = None
-            return
-        delay = min(self.backoff_base_s * (2 ** (self.failures - 1)),
-                    self.backoff_max_s)
-        self._open_until = self._clock() + delay
-
-    def record_success(self) -> None:
-        self.failures = 0
-        self._open_until = None
-        self.permanently_open = False
-
-    def allow(self) -> bool:
-        """May the worker be re-admitted now?"""
-        if self.permanently_open:
-            return False
-        if self._open_until is None:
-            return True
-        return self._clock() >= self._open_until
-
-    def state(self) -> Dict[str, Any]:
-        return {
-            "failures": self.failures,
-            "permanently_open": self.permanently_open,
-            "open_for_s": (None if self._open_until is None
-                           else max(self._open_until - self._clock(), 0.0)),
-        }
+__all__ = [
+    "LEASE_SCHEMA",
+    "CircuitBreaker",
+    "EpochFence",
+    "HeartbeatPublisher",
+    "LeaseTable",
+    "detection_window_s",
+    "make_lease",
+]

@@ -398,6 +398,23 @@ def main(argv=None) -> int:
                              "final async checkpoint + flight bundle + "
                              "exit 0, all within this grace budget "
                              "(requires --checkpoint-dir for the save)")
+    parser.add_argument("--self-heal", action="store_true",
+                        help="run the rank health plane (ISSUE 13): a "
+                             "per-rank heartbeat lease over the KV side "
+                             "channel, a collective watchdog that NAMES "
+                             "a lost rank instead of hanging, and the "
+                             "gang_health /statusz provider; hand-rolled "
+                             "loops add live shrink via "
+                             "SelfHealingGang.heal() — see "
+                             "docs/ROBUSTNESS.md 'Training failure "
+                             "domains'")
+    parser.add_argument("--self-heal-min-world", type=int, default=1,
+                        help="live-shrink floor: below this many "
+                             "survivors heal() refuses and the job falls "
+                             "back to the PR 8 checkpoint restart")
+    parser.add_argument("--self-heal-beat-s", type=float, default=0.05,
+                        help="heartbeat interval; detection window is "
+                             "beat * (miss_beats + 1) with miss_beats=4")
     parser.add_argument("--statusz-port", type=int, default=None,
                         help="live introspection HTTP server (/statusz "
                              "/metricsz /requestz /debugz) on this port; "
@@ -567,7 +584,40 @@ def main(argv=None) -> int:
             checkpointer, grace_s=args.preemption_grace_s,
             dump_dir=dump_dir or args.out, ledger=goodput, rank=rank)
         trainer.extend(preempt)
-    trainer.run()
+    # Self-healing plane (ISSUE 13): heartbeat lease per rank over the
+    # communicator's KV side channel + the collective watchdog threaded
+    # through the accounted face — a rank death during any eager
+    # collective aborts loudly NAMING the lost rank(s) (exit 44, with a
+    # `rank_lost` bundle) instead of wedging the gang.  The min-world
+    # floor is recorded so operators (and heal() callers) know where
+    # live shrink hands back to the PR 8 checkpoint restart.
+    gang = None
+    if args.self_heal:
+        from .extensions.gang import SelfHealingGang
+        gang = SelfHealingGang(
+            comm.gang_lease_store(),
+            rank=jax.process_index(), world=jax.process_count(),
+            name="train", beat_interval_s=args.self_heal_beat_s,
+            min_world=args.self_heal_min_world,
+            dump_dir=dump_dir or args.out)
+        gang.start()
+        # join barrier BEFORE arming any detector: gang processes boot
+        # with arbitrary skew, and a peer that has not started yet must
+        # not read as a death (the guard would exit-44 a healthy gang)
+        gang.wait_for_members(timeout_s=120.0)
+        # the guard bound tracks the GANG's op bound (4× the lease
+        # window, ≥ 5 s), floored at 30 s so a legitimately slow eager
+        # object collective (blocking KV get on a busy peer) is not
+        # mistaken for a death — NOT the step watchdog's budget, which
+        # would delay naming a dead rank by many minutes.  Sub-second
+        # death detection itself comes from the lease window.
+        gang.install_collective_guard(
+            timeout_s=max(gang.op_timeout_s, 30.0))
+    try:
+        trainer.run()
+    finally:
+        if gang is not None:
+            gang.stop()
     updater.close()  # stop the prefetch thread (no-op when not prefetching)
 
     final = log.log[-1] if log.log else {}
@@ -578,6 +628,12 @@ def main(argv=None) -> int:
         "final_accuracy": final.get("main/accuracy"),
         "goodput": goodput.report(),
     }
+    if gang is not None:
+        st = gang.stats()
+        result["self_heal"] = {
+            k: st[k] for k in (
+                "epoch", "world", "min_world", "detection_window_s",
+                "rank_lost_events", "reconfigs", "fenced_refusals")}
     if statusz is not None:
         result["statusz_port"] = statusz.port
         statusz.stop()

@@ -84,13 +84,18 @@ _SKIP = re.compile(
 #: metric (1.0 is perfect reuse), stale fallbacks mean the global
 #: index over-promised, spills mean device cache pressure, and any
 #: CRC refusal means corrupt state reached a receiver — more of any
-#: means the KV economy got worse, ISSUE 12).
+#: means the KV economy got worse, ISSUE 12;
+#: reconfig/consensus/steps_lost: the train_chaos section's keys —
+#: the live-shrink wall (detection already gates via `detection`),
+#: the membership-agreement wall, and the steps a recovery replays
+#: (live shrink must hold 0) — more of any means the self-healing
+#: gang got slower or lossier, ISSUE 13).
 _LOWER = re.compile(
     r"(time|_ms|ms_|/ms$|^ms$|latency|seconds|_s$|/s$|bytes|loss|"
     r"step_ms|gap|slowdown|imbalance|drift|anomal|dropped|findings|"
     r"rejected|shed|steps_to_recover|variance|requeue|detection|"
     r"failover|fenced|redispatch|flap|ttft|rung|degraded|"
-    r"prefill_calls|stale|spill|crc)",
+    r"prefill_calls|stale|spill|crc|reconfig|consensus|steps_lost)",
     re.IGNORECASE)
 
 

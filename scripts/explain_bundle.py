@@ -294,6 +294,49 @@ def explain(bundle: dict) -> dict:
         if isinstance(tenancy.get("ladder"), dict):
             out.setdefault("degradation", {})["ladder"] = \
                 tenancy["ladder"]
+    # training-gang bundles (ISSUE 13): which rank died, how stale its
+    # lease was when the watchdog named it, what the survivors agreed
+    # the new gang is, what the reconfiguration cost, and whether the
+    # decision was live shrink or the checkpoint-restart fallback
+    rl = extra.get("rank_lost")
+    if isinstance(rl, dict):
+        out["rank_lost"] = {
+            "missing": rl.get("missing"),
+            "op": rl.get("op"),
+            "epoch": rl.get("epoch"),
+            "lease_age_s": rl.get("lease_age_s"),
+            "detection_window_s": rl.get("detection_window_s"),
+            "elapsed_s": rl.get("elapsed_s"),
+            "gap_s": rl.get("gap_s"),
+            "step": rl.get("step"),
+            "world": rl.get("world"),
+            "source": rl.get("source"),
+        }
+    gr = extra.get("gang_reconfig")
+    if isinstance(gr, dict):
+        out["gang_reconfig"] = {
+            "decision": gr.get("decision"),
+            "old_world": gr.get("old_world"),
+            "new_world": gr.get("new_world"),
+            "dead": gr.get("dead"),
+            "members": gr.get("members"),
+            "survivors": gr.get("survivors"),
+            "min_world": gr.get("min_world"),
+            "old_epoch": gr.get("old_epoch"),
+            "epoch": gr.get("epoch"),
+            "resume_iteration": gr.get("resume_iteration"),
+            "detection_ms": gr.get("detection_ms"),
+            "consensus_wall_ms": gr.get("consensus_wall_ms"),
+            "reshard_wall_ms": gr.get("reshard_wall_ms"),
+        }
+    gang = providers.get("gang_health")
+    if isinstance(gang, dict):
+        out["gang_at_death"] = {
+            k: gang.get(k)
+            for k in ("member", "rank", "epoch", "members", "world",
+                      "min_world", "suspects", "fenced_members",
+                      "fenced_refusals", "rank_lost_events", "reconfigs",
+                      "last_step")}
     # preemption bundles (ISSUE 8): the scheduler took the node, not a
     # bug — surface the grace accounting and the elastic resume hint
     pre = (man.get("extra") or {}).get("preempt")
@@ -440,6 +483,50 @@ def render_text(rep: dict) -> str:
                 f"    {name} ({t.get('priority')}): admitted "
                 f"{t.get('admitted')}, degraded {t.get('degraded')}, "
                 f"shed {json.dumps(t.get('shed') or {})}")
+    if rep.get("rank_lost"):
+        rl = rep["rank_lost"]
+        lines.append(
+            f"  rank lost: {rl.get('missing')} during collective "
+            f"{rl.get('op')!r} (epoch {rl.get('epoch')}, step "
+            f"{rl.get('step')}, world {rl.get('world')})")
+        ages = rl.get("lease_age_s")
+        lines.append(
+            f"    lease age at detection: {json.dumps(ages)}s "
+            f"(window {rl.get('detection_window_s')}s"
+            + (f", op waited {rl['elapsed_s']}s"
+               if rl.get("elapsed_s") is not None else "")
+            + (f", guard gap {rl['gap_s']}s"
+               if rl.get("gap_s") is not None else "")
+            + ")")
+    if rep.get("gang_reconfig"):
+        gr = rep["gang_reconfig"]
+        if gr.get("decision") == "checkpoint_restart":
+            lines.append(
+                f"  gang reconfig REFUSED: {len(gr.get('survivors') or [])} "
+                f"survivor(s) {gr.get('survivors')} below min-world "
+                f"{gr.get('min_world')} — decision: checkpoint restart "
+                f"(PR 8 elastic resume)")
+        else:
+            lines.append(
+                f"  gang reconfig: world {gr.get('old_world')} -> "
+                f"{gr.get('new_world')} (epoch {gr.get('old_epoch')} -> "
+                f"{gr.get('epoch')}), dead {gr.get('dead')} — decision: "
+                f"live shrink, resume step "
+                f"{gr.get('resume_iteration')} + 1 (0 steps lost, no "
+                f"checkpoint read)")
+            lines.append(
+                f"    detection {gr.get('detection_ms')}ms, consensus "
+                f"{gr.get('consensus_wall_ms')}ms, reshard "
+                f"{gr.get('reshard_wall_ms')}ms")
+    if rep.get("gang_at_death"):
+        ga = rep["gang_at_death"]
+        lines.append(
+            f"  gang at death: member {ga.get('member')} (rank "
+            f"{ga.get('rank')}) of {ga.get('members')} at epoch "
+            f"{ga.get('epoch')}; fenced {ga.get('fenced_members')}, "
+            f"refusals {json.dumps(ga.get('fenced_refusals'))}, "
+            f"rank_lost events {ga.get('rank_lost_events')}, reconfigs "
+            f"{ga.get('reconfigs')}")
     if rep.get("preempt"):
         pre = rep["preempt"]
         used = pre.get("grace_used_s")

@@ -1,0 +1,164 @@
+"""Self-healing gang worker — run by tests/test_chaos_gang.py.
+
+ISSUE 13's chaos acceptance: a REAL multi-process training gang over a
+``FileLaneStore`` side channel (no jax.distributed coordinator — the
+whole point is surviving member death, which a fixed-size runtime cannot
+express), running the same deterministic world-size-INDEPENDENT toy
+problem as tests/_chaos_worker.py's elastic modes: replicated ``w``,
+axis-0-sharded momentum ``m`` updated by LOGICAL index, fixed global
+batch — so the per-step losses are identical at any world size (modulo
+float summation order; the tests compare allclose).
+
+Modes (argv[4]):
+
+* ``base`` — an uninterrupted n-member run printing ``LOSS it value``
+  per step: the reference trajectory.
+* ``heal`` — the victim delivers itself a REAL ``SIGKILL`` right before
+  step ``kill_at``'s first collective, landing mid-allreduce for every
+  survivor by construction.  Survivors must detect the loss within the
+  lease window, print ``RANK_LOST [victim]``, run the consensus live
+  shrink (``RECONFIG old->new``), re-partition the momentum off the
+  shard leases via ``reshard_host`` (NO checkpoint is ever written or
+  read in this mode), and finish with losses matching ``base``.
+* ``zombie`` — the victim self-``SIGSTOP``\\ s at the same point; the
+  parent ``SIGCONT``\\ s it after the survivors reconfigure.  The
+  resumed zombie's first lane operation must die loudly with
+  ``GangFencedError`` (prints ``FENCED``, exit 3), and the survivors
+  must count its post-fence lease writes as refusals
+  (``FENCED_REFUSALS n``).
+
+Usage: python tests/_gang_worker.py <n> <i> <lane_dir> <mode> \
+           <kill_at> <victim>
+Prints ``WORKER_OK <i>`` on success; assertions kill the worker nonzero.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+E_TOTAL = 8
+E_M = 12      # logical momentum length — divides 4 and 3
+E_BATCH = 12  # fixed global batch — divides 4 and 3
+
+
+def make_state(rank, world):
+    import numpy as np
+
+    block = E_M // world
+    return {"m": np.zeros(block, np.float64), "w": float(0.0)}
+
+
+def step(gang, state, it):
+    """One deterministic update over the FIXED logical index space —
+    identical trajectory at any world size (see _chaos_worker.py)."""
+    import math
+
+    world, rank = gang.world, gang.rank
+    per = E_BATCH // world
+    lo = rank * per
+    partial = sum(
+        math.tanh(0.1 * float(state["w"])
+                  + 0.01 * (((it * E_BATCH + j) % 7) - 3))
+        for j in range(lo, lo + per))
+    grad = gang.allreduce(partial, label=f"grad{it}")
+
+    block = E_M // world
+    base = rank * block
+    for k in range(block):
+        state["m"][k] = 0.9 * state["m"][k] + 0.1 * grad * (base + k + 1)
+    msum = gang.allreduce(float(state["m"].sum()), label=f"msum{it}")
+    state["w"] = float(state["w"]) - 0.01 * msum
+    return float(state["w"]) ** 2 + 0.001 * it
+
+
+def repartition_from_shards(rc, target_it):
+    """Rebuild my new-world momentum block from the gang's shard leases
+    (the checkpoint-free path: every payload lives on the side channel,
+    published at the last completed step)."""
+    import numpy as np
+
+    from chainermn_tpu.parallel.reshard import reshard_host
+
+    blocks = []
+    w = None
+    for m in rc.old_members:
+        entry = rc.shards.get(m)
+        assert entry is not None, (
+            f"member {m} has no shard lease — cannot live-shrink")
+        assert entry["iteration"] == target_it, (
+            f"member {m} shard at iteration {entry['iteration']}, "
+            f"expected {target_it}")
+        blocks.append({"m": np.asarray(entry["payload"]["m"])})
+        w = entry["payload"]["w"]
+    new_shards = reshard_host(blocks, {"m": 0}, {"m": 0}, rc.new_world)
+    return {"m": new_shards[rc.new_rank]["m"].copy(), "w": float(w)}
+
+
+def main():
+    n, i, lane_dir, mode = (int(sys.argv[1]), int(sys.argv[2]),
+                            sys.argv[3], sys.argv[4])
+    kill_at, victim = int(sys.argv[5]), int(sys.argv[6])
+
+    import signal
+
+    from chainermn_tpu.extensions.gang import SelfHealingGang
+    from chainermn_tpu.health import GangFencedError, RankLostError
+    from chainermn_tpu.serving.lanes import FileLaneStore
+
+    bundles = os.path.join(lane_dir, "bundles")
+    gang = SelfHealingGang(
+        FileLaneStore(os.path.join(lane_dir, "lanes")), rank=i, world=n,
+        name="chaos", beat_interval_s=0.05, miss_beats=4, min_world=2,
+        dump_dir=bundles)
+    gang.start()
+    gang.wait_for_members(timeout_s=60.0)
+
+    state = make_state(i, n)
+    it = 0
+    killed = False
+    try:
+        while it < E_TOTAL:
+            if mode in ("heal", "zombie") and i == victim \
+                    and it == kill_at and not killed:
+                killed = True
+                if mode == "heal":
+                    os.kill(os.getpid(), signal.SIGKILL)  # never returns
+                os.kill(os.getpid(), signal.SIGSTOP)  # zombie: parent
+                #                                       SIGCONTs us later
+            try:
+                loss = step(gang, state, it)
+                print(f"LOSS {it} {loss:.15e}", flush=True)
+                gang.publish_shard(it, {"m": state["m"], "w": state["w"]})
+                it += 1
+            except RankLostError as e:
+                print(f"RANK_LOST {sorted(e.ranks)}", flush=True)
+                target = it - 1
+                rc = gang.heal(
+                    repartition=lambda rc: repartition_from_shards(
+                        rc, target))
+                assert rc.resume_iteration() == target, (
+                    rc.resume_iteration(), target)
+                state = rc.repartitioned
+                print(f"RECONFIG {rc.old_world}->{rc.new_world} "
+                      f"epoch {rc.epoch} dead {rc.dead}", flush=True)
+                # `it` unchanged: re-run the failed step on the new gang
+    except GangFencedError as e:
+        print(f"FENCED {e}", flush=True)
+        gang.stop(release=False)  # a zombie must NOT delete its lease:
+        #   the survivors count its post-fence writes as refusals
+        sys.exit(3)
+
+    if mode == "zombie" and i != victim:
+        # linger bounded: the resumed zombie's old-epoch lease writes
+        # must be refused AND counted — the fencing acceptance evidence
+        refused = gang.await_fenced_refusals(min_count=1, timeout_s=30.0)
+        print(f"FENCED_REFUSALS {refused}", flush=True)
+        print(f"FENCED_KINDS {gang.fenced_refusals()}", flush=True)
+
+    gang.stop()
+    print(f"WORKER_OK {i}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,229 @@
+"""Chaos tests for the self-healing training gang (ISSUE 13).
+
+The acceptance drill, on REAL processes over a shared ``FileLaneStore``
+(no jax.distributed coordinator — member death must be survivable, and a
+fixed-size runtime cannot express that):
+
+* ``test_sigkill_mid_allreduce_live_shrink`` — an n=4 gang has one rank
+  REALLY SIGKILLed mid-allreduce.  The survivors detect the loss within
+  the documented lease window, raise a :class:`RankLostError` NAMING the
+  rank, dump a ``rank_lost`` bundle, agree on the n=3 gang via the
+  membership consensus, re-partition the sharded momentum off the shard
+  leases (NO checkpoint is written or read anywhere in the run), and
+  continue — their per-step losses allclose-match an uninterrupted n=3
+  run across the WHOLE trajectory (the toy problem is world-size
+  independent by construction; see tests/_gang_worker.py).  Zero
+  survivor hangs: the whole gang is bounded by the subprocess timeout.
+
+* ``test_sigstop_zombie_is_fenced_and_counted`` — one rank is SIGSTOPped
+  (a real zombie: alive but silent).  The survivors shrink without it;
+  when the parent SIGCONTs it, its post-fence lease writes are refused
+  and counted by every survivor, and its own next lane operation dies
+  loudly with ``GangFencedError`` (exit 3) instead of split-braining.
+
+``scripts/explain_bundle.py`` must render both bundle kinds.
+"""
+
+import json
+import os
+import pickle
+import re
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+_WORKER = os.path.join(os.path.dirname(__file__), "_gang_worker.py")
+_EXPLAIN = os.path.join(os.path.dirname(__file__), "..", "scripts",
+                        "explain_bundle.py")
+
+N = 4
+VICTIM = 2
+KILL_AT = 4
+E_TOTAL = 8
+
+
+def _clean_env() -> dict:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = [f for f in env.get("XLA_FLAGS", "").split()
+             if "host_platform_device_count" not in f]
+    env["XLA_FLAGS"] = " ".join(flags)
+    return env
+
+
+def _spawn(n, tmpdir, mode, kill_at=KILL_AT, victim=VICTIM):
+    return [
+        subprocess.Popen(
+            [sys.executable, _WORKER, str(n), str(i), tmpdir, mode,
+             str(kill_at), str(victim)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=_clean_env())
+        for i in range(n)
+    ]
+
+
+def _communicate(procs, timeout=240):
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise AssertionError(
+                "gang did not terminate — the self-healing story has a "
+                "silent hang:\n" + "\n".join(o or "" for o in outs))
+        outs.append(out)
+    return outs
+
+
+def _losses(out: str) -> dict:
+    return {int(m.group(1)): float(m.group(2))
+            for m in re.finditer(r"^LOSS (\d+) (\S+)$", out, re.M)}
+
+
+def _run_base(tmp_path, n):
+    procs = _run = _spawn(n, str(tmp_path), "base", kill_at=10 ** 6)
+    outs = _communicate(_run)
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"base worker {i}:\n{out[-3000:]}"
+        assert f"WORKER_OK {i}" in out
+    return _losses(outs[0])
+
+
+@pytest.mark.slow
+def test_sigkill_mid_allreduce_live_shrink(tmp_path):
+    # ---- the reference: an uninterrupted n=3 run ----
+    base = _run_base(tmp_path / "base", N - 1)
+    assert sorted(base) == list(range(E_TOTAL))
+
+    # ---- the chaos run: n=4, victim SIGKILLed mid-allreduce ----
+    tmpdir = str(tmp_path / "heal")
+    os.makedirs(tmpdir)
+    procs = _spawn(N, tmpdir, "heal")
+    outs = _communicate(procs)
+
+    import signal
+    assert procs[VICTIM].returncode == -signal.SIGKILL, (
+        procs[VICTIM].returncode, outs[VICTIM][-2000:])
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        if i == VICTIM:
+            continue
+        assert p.returncode == 0, f"survivor {i}:\n{out[-4000:]}"
+        assert f"WORKER_OK {i}" in out, out[-2000:]
+        # detection NAMES the rank; the shrink lands on n=3, fresh epoch
+        assert f"RANK_LOST [{VICTIM}]" in out, out[-2000:]
+        assert f"RECONFIG 4->3 epoch 2 dead [{VICTIM}]" in out, out[-2000:]
+
+    # ---- the acceptance: the healed trajectory IS the n=3 one ----
+    survivor = next(i for i in range(N) if i != VICTIM)
+    healed = _losses(outs[survivor])
+    assert sorted(healed) == list(range(E_TOTAL)), healed
+    np.testing.assert_allclose(
+        [healed[i] for i in range(KILL_AT, E_TOTAL)],
+        [base[i] for i in range(KILL_AT, E_TOTAL)], rtol=1e-9)
+    # (and the pre-kill prefix matches too: world-size independence)
+    np.testing.assert_allclose(
+        [healed[i] for i in range(KILL_AT)],
+        [base[i] for i in range(KILL_AT)], rtol=1e-9)
+
+    # ---- bundles: rank_lost names the rank, gang_reconfig prices it --
+    bundles = os.path.join(tmpdir, "bundles")
+    names = sorted(os.listdir(bundles))
+    rank_lost = [b for b in names if "-rank_lost" in b]
+    reconfig = [b for b in names if "-gang_reconfig" in b]
+    assert len(rank_lost) >= N - 1, names   # one per survivor
+    assert len(reconfig) >= N - 1, names
+
+    out = subprocess.run(
+        [sys.executable, _EXPLAIN, os.path.join(bundles, rank_lost[0]),
+         "--json"], capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stderr
+    rep = json.loads(out.stdout)
+    assert rep["reason"] == "rank_lost"
+    assert rep["rank_lost"]["missing"] == [VICTIM]
+    assert rep["rank_lost"]["detection_window_s"] == 0.25
+    ages = rep["rank_lost"]["lease_age_s"]
+    assert ages[str(VICTIM)] > 0.25, ages
+
+    out = subprocess.run(
+        [sys.executable, _EXPLAIN, os.path.join(bundles, reconfig[0]),
+         "--json"], capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stderr
+    rep = json.loads(out.stdout)
+    assert rep["reason"] == "gang_reconfig"
+    gr = rep["gang_reconfig"]
+    assert gr["old_world"] == 4 and gr["new_world"] == 3
+    assert gr["dead"] == [VICTIM]
+    assert gr["decision"] == "live_shrink"
+    assert gr["resume_iteration"] == KILL_AT - 1
+    assert gr["consensus_wall_ms"] is not None
+    assert gr["reshard_wall_ms"] is not None
+    # text rendering mentions the decision too
+    out = subprocess.run(
+        [sys.executable, _EXPLAIN, os.path.join(bundles, reconfig[0])],
+        capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stderr
+    assert "live shrink" in out.stdout
+    assert "no checkpoint read" in out.stdout
+
+
+def _wait_for_epoch(lane_dir, member, epoch, timeout_s=120.0):
+    """Parent-side probe: poll the lease file of ``member`` until its
+    epoch reaches ``epoch`` (the survivors finished reconfiguring)."""
+    # FileLaneStore escapes '/' in "lease/chaos-r<m>"; '_' is the escape
+    # lead so match the literal suffix instead of re-encoding here.
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        try:
+            for name in os.listdir(lane_dir):
+                if not name.endswith(f"chaos-r{member}") \
+                        or name.startswith(".tmp-"):
+                    continue
+                with open(os.path.join(lane_dir, name), "rb") as f:
+                    lease = pickle.loads(f.read())
+                if lease.get("epoch", 0) >= epoch:
+                    return lease
+        except (OSError, EOFError, pickle.UnpicklingError):
+            pass
+        time.sleep(0.05)
+    raise AssertionError(
+        f"member {member} never reached epoch {epoch} in {lane_dir}")
+
+
+@pytest.mark.slow
+def test_sigstop_zombie_is_fenced_and_counted(tmp_path):
+    tmpdir = str(tmp_path)
+    procs = _spawn(N, tmpdir, "zombie")
+
+    # wait for EVERY survivor to fence the zombie and reconfigure (its
+    # lease reaches epoch 2 — the fence baseline is set before that
+    # beat), then wake the zombie: a laggard survivor woken too early
+    # would baseline AFTER the short-lived zombie's final write and
+    # legitimately have nothing left to count
+    for survivor in range(N):
+        if survivor != VICTIM:
+            _wait_for_epoch(os.path.join(tmpdir, "lanes"), survivor, 2)
+    import signal
+    os.kill(procs[VICTIM].pid, signal.SIGCONT)
+
+    outs = _communicate(procs)
+    # the zombie's next lane op dies loudly: fenced, exit 3
+    assert procs[VICTIM].returncode == 3, (
+        procs[VICTIM].returncode, outs[VICTIM][-3000:])
+    assert "FENCED" in outs[VICTIM], outs[VICTIM][-2000:]
+    assert f"WORKER_OK {VICTIM}" not in outs[VICTIM]
+    # every survivor finished the run AND counted the zombie's
+    # post-fence lease writes as refusals
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        if i == VICTIM:
+            continue
+        assert p.returncode == 0, f"survivor {i}:\n{out[-4000:]}"
+        assert f"WORKER_OK {i}" in out, out[-2000:]
+        assert f"RECONFIG 4->3 epoch 2 dead [{VICTIM}]" in out, out[-2000:]
+        m = re.search(r"^FENCED_REFUSALS (\d+)$", out, re.M)
+        assert m, out[-2000:]
+        assert int(m.group(1)) >= 1, out[-2000:]

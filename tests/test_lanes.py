@@ -154,6 +154,55 @@ class TestLaneCall:
                            "kv_store:permanent:1")
         assert lane_call("other_lane", lambda: 3, cfg) == 3
 
+    def test_env_fault_injector_fire_after_n(self, monkeypatch):
+        """Per-op targeting (ISSUE 13): ``:after=N`` lets the first N
+        matching lane calls pass clean, so a chaos drill can kill a
+        SPECIFIC collective step instead of the first lane op."""
+        import chainermn_tpu.communicators.base as base
+
+        monkeypatch.setenv("CHAINERMN_TPU_LANE_FAULT",
+                           "kv_store:transient:1:after=2")
+        monkeypatch.setattr(base, "_ENV_FAULT", None)
+        cfg = _cfg()
+        flight.get_flight_recorder().clear()
+        assert lane_call("kv_store/get/a", lambda: 1, cfg) == 1  # skip 1
+        assert lane_call("kv_store/get/a", lambda: 2, cfg) == 2  # skip 2
+        assert not [ev for ev in flight.get_flight_recorder().events()
+                    if ev["kind"] == "dcn_lane_retry"]
+        # the THIRD matching call eats the (transient) fault
+        assert lane_call("kv_store/get/a", lambda: 3, cfg) == 3
+        retries = [ev for ev in flight.get_flight_recorder().events()
+                   if ev["kind"] == "dcn_lane_retry"]
+        assert len(retries) == 1
+        # budget spent: later calls are clean again
+        assert lane_call("kv_store/get/a", lambda: 4, cfg) == 4
+        assert len([ev for ev in flight.get_flight_recorder().events()
+                    if ev["kind"] == "dcn_lane_retry"]) == 1
+
+    def test_env_fault_injector_glob_pattern(self, monkeypatch):
+        """A glob pattern matches the FULL lane name, so two same-shaped
+        collectives at different steps are distinguishable."""
+        import chainermn_tpu.communicators.base as base
+
+        monkeypatch.setenv("CHAINERMN_TPU_LANE_FAULT",
+                           "gang/*/x/step7/*:permanent:1")
+        monkeypatch.setattr(base, "_ENV_FAULT", None)
+        cfg = _cfg()
+        assert lane_call("gang/t/x/step6/put", lambda: 1, cfg) == 1
+        with pytest.raises(DcnLaneError) as ei:
+            lane_call("gang/t/x/step7/put", lambda: 2, cfg)
+        assert "step7" in ei.value.lane
+        # budget spent deterministically on the targeted step only
+        assert lane_call("gang/t/x/step7/put", lambda: 3, cfg) == 3
+
+    def test_env_fault_injector_rejects_bad_kind(self, monkeypatch):
+        import chainermn_tpu.communicators.base as base
+
+        monkeypatch.setenv("CHAINERMN_TPU_LANE_FAULT", "lane:weird:1")
+        monkeypatch.setattr(base, "_ENV_FAULT", None)
+        with pytest.raises(ValueError, match="transient|permanent"):
+            base._env_fault_state()
+
     def test_dcn_lane_error_never_reclassified(self):
         """A DcnLaneError from a nested lane_call propagates untouched
         (no double-wrapping, no retry of an already-final verdict)."""
