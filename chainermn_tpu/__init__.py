@@ -57,10 +57,14 @@ from .evaluators import (  # noqa: F401
     create_multi_node_evaluator,
 )
 from .optimizers import (  # noqa: F401
+    ErrorFeedbackState,
     compressed_mean,
     create_multi_node_optimizer,
+    error_feedback_layout,
+    fold_error_feedback,
     gradient_average,
     hierarchical_gradient_average,
+    opt_state_partition_specs,
 )
 from .train import (  # noqa: F401
     make_flax_train_step,

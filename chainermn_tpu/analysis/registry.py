@@ -30,6 +30,8 @@ from typing import FrozenSet, Optional
 _NON_COLLECTIVE_OPS = frozenset({
     "zeros_like_vma", "axis_index", "axis_size",
     "collective_wire_cost", "quantized_ring_cost",
+    "quantized_ring_static_groups", "choose_pipeline_depth",
+    "block_quantize", "block_dequantize",
 })
 
 #: jax.lax collective primitives (the fixed upstream vocabulary the named

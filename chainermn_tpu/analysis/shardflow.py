@@ -24,7 +24,10 @@ sharding, the ``reshard`` primitive) stand or fall on:
   physical ring decomposition (``ops.collective.collective_wire_cost``:
   per-rank wire bytes and message counts from the axis size), with scan
   trip counts reported as multipliers.  The quantized int8 ring is
-  modeled analytically by ``ops.collective.quantized_ring_cost``.
+  modeled analytically by ``ops.collective.quantized_ring_cost``; a
+  declaring entry point swaps its composite ledger row for the
+  per-primitive groups of ``quantized_ring_static_groups`` via the
+  ``composite`` build-spec key (see the reconciliation section below).
 
 * **Peak live memory per replica** — classical liveness over the jaxpr:
   a value is live from its defining equation to its last use; the peak
@@ -762,6 +765,32 @@ def analyze_entrypoint(ep, reconcile: bool = True,
         vma = ad_inserts_replicated_psum()
 
         expected: Dict[str, int] = dict(wrapped)
+
+        # COMPOSITE rows (LEDGER_TO_PRIMITIVE → None, e.g. the quantized
+        # int8 ring): the entry declares, per ledger row, (a) the bytes
+        # the accountant must have booked for it (the compressed-wire
+        # ledger convention) and (b) the per-primitive-group payload
+        # bytes its hand-written schedule puts in the traced program
+        # (``ops.collective.quantized_ring_static_groups``).  The row is
+        # swapped for its equation groups before the comparison, so the
+        # schedule is held byte-exact like any wrapped collective.
+        composite_ok = True
+        for key, decl in sorted(dict(spec.get("composite", {})).items()):
+            booked = expected.pop(key, 0)
+            want_row = int(decl.get("ledger_bytes", 0))
+            if booked != want_row:
+                composite_ok = False
+                findings.append(Finding(
+                    rule="comm-ledger-gap", severity="error", path=loc,
+                    line=0, context=ep.name,
+                    message=(
+                        f"composite ledger row `{key}` books {booked} "
+                        f"bytes but the entry point declares {want_row} "
+                        "— the compressed-wire convention and the "
+                        "declaration drifted apart"),
+                    snippet=f"composite:{key}"))
+            for g, b in dict(decl.get("static_groups", {})).items():
+                expected[g] = expected.get(g, 0) + int(b)
         if not vma:
             # legacy jax: transpose(psum) is a psum — declared equations
             # the ledger cannot book
@@ -777,7 +806,7 @@ def analyze_entrypoint(ep, reconcile: bool = True,
                 expected[g] = expected.get(g, 0) + int(b)
         report.expected_static = expected
 
-        ok = True
+        ok = composite_ok
         for g in sorted(set(expected) | set(report.static_groups)):
             want = expected.get(g, 0)
             got = report.static_groups.get(g, 0)

@@ -6,6 +6,9 @@ from .collective import (  # noqa: F401
     axis_index,
     axis_size,
     bcast,
+    block_dequantize,
+    block_quantize,
+    choose_pipeline_depth,
     hierarchical_pmean,
     pmax,
     pmean,

@@ -35,10 +35,13 @@ from ..topology import DEFAULT_AXIS_NAME
 #: several wrappers share one primitive (``psum``/``pmean``/the autodiff
 #: grad note all land on ``psum``), so reconciliation happens per
 #: primitive group.  ``None`` marks a COMPOSITE op whose wire legs are a
-#: hand-written schedule (the quantized int8 ring: ppermute/psum pairs at
-#: the wire dtype plus fp32 scales) — its cost comes from
-#: :func:`quantized_ring_cost`, not from a single equation.  Kept as a
-#: literal so the jax-free analysis registry can read it by parsing.
+#: hand-written schedule (the quantized int8 ring: per-hop sub-chunk
+#: ppermutes at the wire dtype plus fp32 block scales, then a tiled int8
+#: all_gather ring) — its cost comes from :func:`quantized_ring_cost`,
+#: its per-equation groups from :func:`quantized_ring_static_groups`
+#: (declared as ``composite`` by the owning entry point), never from a
+#: single equation.  Kept as a literal so the jax-free analysis registry
+#: can read it by parsing.
 LEDGER_TO_PRIMITIVE = {
     "psum": "psum",
     "pmean": "psum",
@@ -90,20 +93,58 @@ def collective_wire_cost(primitive: str, payload_bytes: int,
     return {"wire_bytes": b, "messages": 1}  # unknown: conservative
 
 
+#: Default quantization block: ~256 elements per fp32 scale bounds the
+#: per-block error at ``blockmax/254`` while keeping scale traffic under
+#: 1.6% of the int8 payload (4 bytes per 256).  EQuARX (PAPERS.md) uses
+#: the same block ≪ chunk regime.
+DEFAULT_QUANT_BLOCK = 256
+
+
+def _ring_layout(n_elements: int, axis_size: int, block: int,
+                 pipeline: int):
+    """The ONE chunk/block/sub-chunk layout both the kernel
+    (:func:`quantized_ring_pmean`) and the static cost model
+    (:func:`quantized_ring_cost`) derive their numbers from — byte-exact
+    reconciliation is only possible if padding is decided in one place.
+
+    Returns ``(chunk_len, eff_block, nb_sub, k)``: each rank owns one
+    chunk of ``chunk_len = k * nb_sub * eff_block`` elements (``n``
+    padded up to ``p * chunk_len``), organized as ``k`` pipeline
+    sub-chunks of ``nb_sub`` quantization blocks each.  ``eff_block``
+    shrinks to the raw chunk for tiny leaves so a 64-element leaf is not
+    padded to 256.
+    """
+    p = max(1, int(axis_size))
+    raw = -(-max(1, int(n_elements)) // p)       # ceil(n / p)
+    eff_block = max(1, min(int(block), raw))
+    k = max(1, int(pipeline))
+    nb_sub = -(-raw // (k * eff_block))          # blocks per sub-chunk
+    return k * nb_sub * eff_block, eff_block, nb_sub, k
+
+
 def quantized_ring_cost(n_elements: int, axis_size: int,
-                        wire_dtype="int8") -> dict:
+                        wire_dtype="int8",
+                        block: int = DEFAULT_QUANT_BLOCK,
+                        pipeline: int = 1) -> dict:
     """Analytic wire cost of :func:`quantized_ring_pmean` — the composite
     op ``LEDGER_TO_PRIMITIVE`` maps to ``None``.
 
     Returns ``{"ledger_bytes", "wire_bytes", "scale_bytes", "messages"}``
     per rank: ``ledger_bytes`` is what the accountant books for the call
     (``n_elements × itemsize(wire_dtype)`` — the documented compressed-
-    wire convention), ``wire_bytes`` the physical payload hops (the
-    reduce-scatter phase re-quantizes and forwards one ``N/P`` chunk per
-    hop for ``P-1`` hops, the all-gather phase is one psum of a one-hot
-    ``N``-row buffer), and ``scale_bytes`` the fp32 per-chunk scales that
-    ride alongside — the dtype-dependent padding the reconciliation
-    contract tolerates (docs/ANALYSIS.md).
+    wire convention), ``wire_bytes`` the physical payload hops, and
+    ``scale_bytes`` the fp32 per-BLOCK scales that ride alongside — the
+    scale-traffic carve-out of the reconciliation contract
+    (docs/ANALYSIS.md).
+
+    The schedule is the MINIMAL ring decomposition: the reduce-scatter
+    phase re-quantizes and forwards one ``chunk`` per hop for ``P-1``
+    hops (``k`` pipelined sub-chunk messages per hop, fp32 block scales
+    bitcast IN-BAND behind each payload — one message, not two), and
+    the gather phase is one tiled int8 ``all_gather`` of the packed
+    finished chunk — a gather ring at ``(P-1) × (chunk + scales)`` wire
+    bytes, replacing the old one-hot-psum phase that paid ``2×`` that
+    (its ``ag_bytes = 2·(p·chunk)·(p−1)/p`` accounting is gone with it).
     """
     p = int(axis_size)
     item = _as_wire_itemsize(wire_dtype)
@@ -111,20 +152,110 @@ def quantized_ring_cost(n_elements: int, axis_size: int,
     if p <= 1:
         return {"ledger_bytes": 0, "wire_bytes": 0, "scale_bytes": 0,
                 "messages": 0}
-    chunk = -(-n // p)  # padded chunk length
-    rs_bytes = (p - 1) * chunk * item
-    ag_bytes = 2 * (p * chunk * item) * (p - 1) // p  # psum of one-hot buffer
-    scales = (p - 1) * 4 + 2 * (p * 4) * (p - 1) // p
+    chunk, _, nb_sub, k = _ring_layout(n, p, block, pipeline)
+    nb = k * nb_sub                              # scale blocks per chunk
+    rs_bytes = (p - 1) * chunk * item            # k packed msgs per hop
+    ag_bytes = (p - 1) * chunk * item            # tiled all_gather ring
+    scales = 2 * (p - 1) * nb * 4                # in-band, both phases
     return {
         "ledger_bytes": n * item,
         "wire_bytes": rs_bytes + ag_bytes,
         "scale_bytes": scales,
-        # the FULL physical schedule, scale traffic included: the RS
-        # phase sends 2 ppermutes per hop (q + scale) over p-1 hops, the
-        # AG phase is TWO ring all-reduces (psum of buf_q and of buf_s)
-        # at 2(p-1) messages each — 6(p-1) total
-        "messages": 2 * (p - 1) + 2 * (2 * (p - 1)),
+        # RS phase: k packed sub-chunk ppermutes per hop over p-1 hops;
+        # AG phase: one packed all_gather at p-1 ring messages
+        "messages": k * (p - 1) + (p - 1),
     }
+
+
+def quantized_ring_static_groups(n_elements: int, axis_size: int,
+                                 axis_name: str = DEFAULT_AXIS_NAME,
+                                 wire_dtype="int8",
+                                 block: int = DEFAULT_QUANT_BLOCK,
+                                 pipeline: int = 1) -> dict:
+    """The quantized ring's traced equations as LEDGER-convention
+    ``primitive@axis -> payload bytes`` groups — what
+    ``analysis.shardflow.static_costs`` derives from the jaxpr.  A
+    declaring entry point (``train.quantized_step``) passes this as its
+    ``composite`` declaration so the reconciliation can hold the
+    hand-written schedule to the traced program byte-exactly."""
+    p = int(axis_size)
+    if p <= 1:
+        return {}
+    item = _as_wire_itemsize(wire_dtype)
+    chunk, _, nb_sub, k = _ring_layout(n_elements, p, block, pipeline)
+    nb = k * nb_sub
+    return {
+        # per hop: k packed sub-chunk ppermutes (int8 payload + in-band
+        # bitcast scales); payload convention = the call's input bytes
+        f"ppermute@{axis_name}": (p - 1) * (chunk * item + nb * 4),
+        # gather phase: one tiled all_gather of the packed finished
+        # chunk (payload = the per-rank input block incl. scales)
+        f"all_gather@{axis_name}": chunk * item + nb * 4,
+    }
+
+
+def choose_pipeline_depth(chunk_bytes: int, bw_bytes_per_s: float = 1.8e11,
+                          alpha_s: float = 1e-6,
+                          dequant_bytes_per_s: float = 4e11,
+                          candidates=(1, 2, 4, 8)) -> int:
+    """Pick the pipeline depth ``k`` for :func:`quantized_ring_pmean`
+    from the r04 multislice cost-model terms (per-hop latency ``alpha``
+    and link bandwidth — v5e ICI defaults, same table as
+    ``bench.project_dp_scaling``).
+
+    Model per ring hop with ``k`` sub-chunks: the transfer of sub-chunk
+    ``j+1`` overlaps the dequant+accumulate of sub-chunk ``j``, so the
+    hop costs ``k·alpha + max(T, D) + min(T, D)/k`` where ``T =
+    chunk_bytes/bw`` and ``D = chunk_bytes/dequant_bw`` — deeper
+    pipelines hide more of the smaller term but pay one ``alpha`` per
+    extra message.  Tiny chunks pick ``k=1``; multi-MB chunks pick the
+    deepest candidate that still amortizes its alphas."""
+    chunk_bytes = max(0, int(chunk_bytes))
+    t = chunk_bytes / float(bw_bytes_per_s)
+    d = chunk_bytes / float(dequant_bytes_per_s)
+
+    def hop_cost(k):
+        return k * float(alpha_s) + max(t, d) + min(t, d) / k
+
+    return min(candidates, key=hop_cost)
+
+
+def block_quantize(v, wire_dtype="int8", block: int = DEFAULT_QUANT_BLOCK):
+    """Symmetric per-BLOCK quantization: ``(q, scales)`` where ``v``
+    (any shape) is flattened, zero-padded to a multiple of the effective
+    block, and quantized as ``q = round(v / scale)`` with one fp32
+    ``scale = blockmax / qmax`` per block — error ≤ ``blockmax/254`` per
+    block for int8.  Pure arithmetic (no wire): the quantizer of the ring
+    schedule and of the error-feedback residual, exposed so tests and the
+    EF transform share the exact operator."""
+    import jax.numpy as jnp
+
+    wire = jnp.dtype(wire_dtype)
+    if not jnp.issubdtype(wire, jnp.integer):
+        raise ValueError(f"wire_dtype must be an integer type, got {wire}")
+    qmax = float(jnp.iinfo(wire).max)
+    flat = v.ravel().astype(jnp.float32)
+    n = flat.shape[0]
+    eff = max(1, min(int(block), n))
+    flat = jnp.pad(flat, (0, (-n) % eff))
+    vb = flat.reshape(-1, eff)
+    scales = jnp.maximum(jnp.max(jnp.abs(vb), axis=-1), 1e-30) / qmax
+    q = jnp.clip(jnp.round(vb / scales[:, None]), -qmax, qmax).astype(wire)
+    return q, scales.astype(jnp.float32)
+
+
+def block_dequantize(q, scales, shape=None, n_elements=None):
+    """Inverse of :func:`block_quantize`: fp32 values, un-padded to
+    ``n_elements`` (or ``prod(shape)``) and reshaped to ``shape``."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    flat = (q.astype(jnp.float32) * scales[:, None]).ravel()
+    if shape is not None and n_elements is None:
+        n_elements = int(np.prod(shape)) if shape else 1
+    if n_elements is not None:
+        flat = flat[:n_elements]
+    return flat.reshape(shape) if shape is not None else flat
 
 
 def _as_wire_itemsize(wire_dtype) -> int:
@@ -259,21 +390,46 @@ def bcast(x, root: int = 0, axis_name: str = DEFAULT_AXIS_NAME):
 
 
 def quantized_ring_pmean(x, axis_name: str = DEFAULT_AXIS_NAME,
-                         wire_dtype="int8"):
-    """Cross-rank mean with **int8 wire traffic**: a hand-scheduled ring
-    all-reduce (reduce-scatter + all-gather over ``ppermute``) where every
-    hop carries ``wire_dtype`` payloads plus one fp32 scale per chunk.
+                         wire_dtype="int8",
+                         block: int = DEFAULT_QUANT_BLOCK,
+                         pipeline: int = 1):
+    """Cross-rank mean with **block-scaled int8 wire traffic**: a
+    hand-scheduled ring all-reduce where every hop carries ``wire_dtype``
+    payloads plus one fp32 scale per ``block`` elements.
 
     Beyond the reference's fp16 ``allreduce_grad_dtype`` (its best was 2
-    bytes/element; this is ~1): the EQuARX recipe (PAPERS.md) — block
-    quantization with requantization at each reduce-scatter hop, a single
-    quantization for the all-gather phase.  Deterministic symmetric
-    quantization: ``q = round(v * 127 / max|v|)``, error per hop ≤
-    ``max|v|/254``, compounding over ``P-1`` hops — use for gradients (noise-
-    tolerant), not for activations.
+    bytes/element; this is ~1): the EQuARX recipe (PAPERS.md, arxiv
+    2506.17615) —
 
-    Call inside ``shard_map`` with ``axis_name`` bound.  Works per-leaf on a
-    pytree.  Chunk layout pads ``x`` to a multiple of the axis size.
+    * **block scales** — one fp32 scale per ``block`` elements (default
+      256, shrunk to the chunk for tiny leaves) instead of one per
+      ``N/P`` chunk: quantization error is bounded per BLOCK
+      (``blockmax/254``), so one outlier no longer flattens the whole
+      chunk's resolution.
+    * **requantization per hop** — each reduce-scatter hop dequantizes
+      the incoming running sum, accumulates its own chunk in fp32, and
+      requantizes before forwarding (``P-1`` hops).
+    * **pipelined sub-chunks** — ``pipeline=k`` splits each chunk into
+      ``k`` independent sub-chunk rings (layout from
+      :func:`_ring_layout`), so the ppermute of sub-chunk ``j+1`` can
+      overlap the dequant+accumulate of sub-chunk ``j`` (XLA's async
+      scheduler owns the actual overlap; the schedule merely exposes the
+      independence).  :func:`choose_pipeline_depth` picks ``k`` from the
+      alpha/bandwidth cost model.
+    * **gather ring** — the all-gather phase is one tiled int8
+      ``all_gather`` of the packed finished chunk (block scales bitcast
+      in-band): the minimal ``(P-1)×chunk`` gather ring, typed
+      replication-invariant by the collective itself (the one-hot-psum
+      phase it replaces paid ~2× the minimal wire; its only virtue was
+      the invariant typing, which ``all_gather`` provides for free).
+      The ring's start offset makes rank ``r`` finish its OWN chunk
+      ``r``, so the gathered rows concatenate in order — no fix-up
+      permutation between the collective and the output.
+
+    Use for gradients (noise-tolerant), not activations.  Call inside
+    ``shard_map`` with ``axis_name`` bound.  Works per-leaf on a pytree
+    (:func:`chainermn_tpu.optimizers.compressed_mean` buckets a whole
+    gradient tree into one flat call).
     """
     import jax.numpy as jnp
 
@@ -287,55 +443,94 @@ def quantized_ring_pmean(x, axis_name: str = DEFAULT_AXIS_NAME,
     idx = jax.lax.axis_index(axis_name)
     perm = [(i, (i + 1) % p) for i in range(p)]
 
-    def quant(v):
-        scale = jnp.maximum(jnp.max(jnp.abs(v)), 1e-30) / qmax
-        q = jnp.clip(jnp.round(v / scale), -qmax, qmax).astype(wire)
+    def quant_rows(vb):
+        # vb: (..., nb, B) -> per-block q + scales
+        scale = jnp.maximum(jnp.max(jnp.abs(vb), axis=-1), 1e-30) / qmax
+        q = jnp.clip(jnp.round(vb / scale[..., None]),
+                     -qmax, qmax).astype(wire)
         return q, scale.astype(jnp.float32)
 
     def one(leaf):
         flat = leaf.ravel().astype(jnp.float32)
         n = flat.shape[0]
-        flat = jnp.pad(flat, (0, (-n) % p))
-        chunks = flat.reshape(p, -1)
+        chunk_len, eff_block, nb_sub, k = _ring_layout(n, p, block, pipeline)
+        flat = jnp.pad(flat, (0, p * chunk_len - n))
+        # (p, k, nb_sub, B): rank-major chunks, each k sub-chunks of
+        # nb_sub quantization blocks
+        chunks = flat.reshape(p, k, nb_sub, eff_block)
 
-        # Reduce-scatter: at step s rank i forwards its running sum for
-        # chunk (i - s) mod p; after P-1 hops rank i holds the full sum of
-        # chunk (i + 1) mod p.  Each hop re-quantizes the running sum.
-        send = jax.lax.dynamic_index_in_dim(chunks, idx, 0, keepdims=False)
+        # Reduce-scatter: rank i STARTS by forwarding chunk (i-1), so at
+        # step s it carries the running sum of chunk (i - 1 - s) mod p
+        # and after P-1 hops finishes its OWN chunk i — the gathered
+        # rows then concatenate in order with no fix-up permutation (the
+        # obvious start-at-own-chunk variant needs a roll after the
+        # gather, and XLA's roll+slice simplification MISCOMPILES that
+        # on the deployment floor's jax 0.4.37).  Each hop re-quantizes
+        # the running sum per block and moves each sub-chunk as its own
+        # packed ppermute, so hop s+1's transfers are independent of hop
+        # s's dequants.
+        # fp32 scales travel IN-BAND, bitcast to the wire dtype behind
+        # the payload: ONE wire message per transfer — half the
+        # rendezvous/DMA descriptors of a separate scale message, same
+        # bytes (quantized_ring_cost's scale_bytes names the in-band
+        # scale share)
+        ratio = 4 // wire.itemsize  # wire words per fp32 scale
+
+        def pack(q, scale):
+            return jnp.concatenate(
+                [q.reshape(-1),
+                 jax.lax.bitcast_convert_type(scale, wire).reshape(-1)])
+
+        def unpack(msg, nb):
+            q = msg[:nb * eff_block].reshape(nb, eff_block)
+            raw = msg[nb * eff_block:].reshape(
+                (nb, ratio) if ratio > 1 else (nb,))
+            return q, jax.lax.bitcast_convert_type(raw, jnp.float32)
+
+        send = jax.lax.dynamic_index_in_dim(chunks, jnp.mod(idx - 1, p),
+                                            0, keepdims=False)
         for s in range(p - 1):
-            q, scale = quant(send)
-            q = jax.lax.ppermute(q, axis_name, perm=perm)
-            scale = jax.lax.ppermute(scale, axis_name, perm=perm)
-            c = jnp.mod(idx - s - 1, p)
-            send = (q.astype(jnp.float32) * scale
-                    + jax.lax.dynamic_index_in_dim(chunks, c, 0,
-                                                   keepdims=False))
+            q, scale = quant_rows(send)            # (k, nb_sub, B), (k, nb_sub)
+            msgs = [jax.lax.ppermute(pack(q[j], scale[j]), axis_name,
+                                     perm=perm)
+                    for j in range(k)]
+            c = jnp.mod(idx - s - 2, p)
+            nxt = jax.lax.dynamic_index_in_dim(chunks, c, 0, keepdims=False)
+            parts = []
+            for j in range(k):
+                qr, sr = unpack(msgs[j], nb_sub)
+                parts.append(qr.astype(jnp.float32) * sr[:, None] + nxt[j])
+            send = jnp.stack(parts)
 
-        # All-gather phase: ONE quantization, then a psum of a one-hot row
-        # buffer (rank r contributes its finished chunk at row r, zeros
-        # elsewhere).  Every element has exactly ONE nonzero contributor, so
-        # the int8 sum cannot overflow, the wire stays ~1 byte/element, and
-        # — unlike ``all_gather`` or a ppermute gather ring, whose outputs
-        # the shard_map VMA checker types as axis-varying — a psum is
-        # provably replication-invariant, so the result can flow to
-        # ``out_specs=P()`` (replicated params) without extra collectives.
-        q, scale = quant(send)
-        buf_q = jnp.zeros((p,) + q.shape, q.dtype)
-        buf_q = jax.lax.dynamic_update_index_in_dim(buf_q, q, idx, axis=0)
-        buf_s = jnp.zeros((p,), jnp.float32)
-        buf_s = jax.lax.dynamic_update_index_in_dim(buf_s, scale, idx, axis=0)
-        gq = jax.lax.psum(buf_q, axis_name)
-        gs = jax.lax.psum(buf_s, axis_name)
-        # Rank r finished chunk (r+1) mod p, so row r holds chunk (r+1);
-        # rolling down one row puts chunk c at row c.
-        deq = jnp.roll(gq.astype(jnp.float32) * gs[:, None], 1, axis=0)
+        # Gather ring: ONE block quantization of the finished chunk, then
+        # a single tiled all_gather of the packed (q + in-band scales)
+        # message — (P-1)×(chunk+scales) minimal wire, replication-
+        # invariant output by construction (the collective itself is the
+        # "replication fix-up": its output is invariant-typed, where a
+        # hand-rolled ppermute gather ring would come out axis-varying).
+        # tiled=True: the non-tiled form hits an XLA CPU fusion bug on
+        # the deployment floor (jax 0.4.37) where the dequant reads the
+        # wrong scale block under jit; the tiled lowering is also the
+        # layout the reshape below wants directly.
+        nb = k * nb_sub
+        q, scale = quant_rows(send.reshape(nb, eff_block))
+        ga = jax.lax.all_gather(pack(q, scale), axis_name, axis=0,
+                                tiled=True).reshape(p, -1)
+        gq = ga[:, :nb * eff_block].reshape(p, nb, eff_block)
+        raw = ga[:, nb * eff_block:].reshape(
+            (p, nb, ratio) if ratio > 1 else (p, nb))
+        gs = jax.lax.bitcast_convert_type(raw, jnp.float32)
+        # rank r finished chunk r, so the gathered rows ARE the chunks
+        # in order — no permutation between gather and output
+        full = (gq.astype(jnp.float32) * gs[..., None]).reshape(p, chunk_len)
 
-        flat_out = deq.ravel()[:n] / p
+        flat_out = full.ravel()[:n] / p
         return flat_out.reshape(leaf.shape).astype(leaf.dtype)
 
     # Accounted at the WIRE dtype: the whole point of this op is that the
     # ring hops carry int8, so the byte ledger reflects ~1 byte/element,
-    # not x's fp32 logical payload.
+    # not x's fp32 logical payload (block scales are the documented
+    # carve-out — quantized_ring_cost's scale_bytes).
     return _acc("quantized_ring_pmean", axis_name, x,
                 lambda: jax.tree_util.tree_map(one, x), wire_dtype=wire)
 

@@ -34,15 +34,32 @@ AD-inserted psum carries the 1/size factor.
 
 from __future__ import annotations
 
-from typing import NamedTuple, Optional, Union
+from typing import Any, NamedTuple, Optional, Union
 
 import jax
 import jax.numpy as jnp
 import optax
 
 from .communicators.base import CommunicatorBase
-from .ops.collective import _axis_bound, pmean, pmean_if_bound
+from .ops.collective import (DEFAULT_QUANT_BLOCK, _axis_bound, pmean,
+                             pmean_if_bound)
 from .topology import DEFAULT_AXIS_NAME
+
+
+class ErrorFeedbackState(NamedTuple):
+    """Per-rank quantization residuals of the int8 gradient bucket.
+
+    ``residuals`` is ONE fp32 leaf of GLOBAL shape ``(world, n_total)``
+    — row ``r`` is rank ``r``'s unsent error mass, sharded over the data
+    axis by :func:`opt_state_partition_specs` so each rank reads/writes
+    only its own ``(1, n_total)`` block inside the step (EF-SGD:
+    ``v = g + e``, send ``Q(v)``, keep ``e' = v - Q(v)``).  It is
+    checkpoint state: :func:`error_feedback_layout` gives the v2
+    manifest layout, :func:`fold_error_feedback` the world-size
+    re-partition for elastic resume / live shrink.
+    """
+
+    residuals: Any
 
 
 def _resolve_axis(communicator: Union[CommunicatorBase, str, None]) -> Optional[str]:
@@ -53,7 +70,28 @@ def _resolve_axis(communicator: Union[CommunicatorBase, str, None]) -> Optional[
     return getattr(communicator, "axis_name", DEFAULT_AXIS_NAME)
 
 
-def compressed_mean(grads, axis_name: Optional[str], allreduce_grad_dtype=None):
+def _bucket(grads):
+    """Flatten a gradient pytree into ONE fp32 vector (+ the recipe to
+    split it back).  The reference's ``_memory_utility`` bucketing,
+    jit-side: one ring call per step instead of one per leaf — fewer
+    per-hop ops AND one ledger row at the bucket's true byte size."""
+    leaves, treedef = jax.tree_util.tree_flatten(grads)
+    sizes = [int(l.size) for l in leaves]
+    flat = jnp.concatenate([l.ravel().astype(jnp.float32) for l in leaves])
+
+    def unbucket(vec):
+        out, off = [], 0
+        for l, s in zip(leaves, sizes):
+            out.append(vec[off:off + s].reshape(l.shape).astype(l.dtype))
+            off += s
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+    return flat, unbucket
+
+
+def compressed_mean(grads, axis_name: Optional[str], allreduce_grad_dtype=None,
+                    quant_block: int = DEFAULT_QUANT_BLOCK,
+                    quant_pipeline: int = 1, residuals=None):
     """Cross-rank gradient mean, optionally wire-compressed to a smaller dtype.
 
     Reference analog: ``PureNcclCommunicator.allreduce_grad_dtype``
@@ -65,6 +103,18 @@ def compressed_mean(grads, axis_name: Optional[str], allreduce_grad_dtype=None):
 
     Each leaf is cast back to its original dtype after the reduction, so the
     optimizer update always runs at model precision.
+
+    Integer ``allreduce_grad_dtype`` (int8) runs the BLOCK-SCALED
+    quantized ring (:func:`~chainermn_tpu.ops.collective
+    .quantized_ring_pmean`): the whole tree is bucketed into one flat
+    vector (one ring call, one ledger row), with ``quant_block`` fp32
+    scale granularity and ``quant_pipeline`` sub-chunk pipelining.
+    ``residuals`` (the ``(1, n_total)`` per-rank block of an
+    :class:`ErrorFeedbackState` leaf) switches on error feedback:
+    the corrected bucket ``v = g + e`` goes on the wire and the return
+    becomes ``(mean_tree, new_residuals)`` with ``e' = v - Dq(Q(v))`` —
+    the EF-SGD update that makes the compounding per-hop quantization
+    error unbiased across steps.
     """
     def already_reduced(g):
         # Provably replication-invariant over the axis (shard_map VMA type):
@@ -80,24 +130,72 @@ def compressed_mean(grads, axis_name: Optional[str], allreduce_grad_dtype=None):
         return not any(n in vma for n in names)
 
     if allreduce_grad_dtype is None:
+        assert residuals is None, "error feedback requires an int wire dtype"
         return jax.tree_util.tree_map(
             lambda g: g if already_reduced(g) else pmean_if_bound(g, axis_name),
             grads)
     wire = jnp.dtype(allreduce_grad_dtype)
 
     if jnp.issubdtype(wire, jnp.integer):
-        # int8 path: a hand-scheduled quantized ring all-reduce (~1
-        # byte/element on the wire vs the reference's 2-byte fp16 best).
-        # Needs a bound axis — the quantized schedule is explicit ppermutes;
-        # under plain pjit (unbound axis) the gradients are already globally
-        # reduced and there is no wire leg left to compress.
-        from .ops.collective import quantized_ring_pmean
+        # int8 path: the block-scaled quantized ring (~1 byte/element on
+        # the wire vs the reference's 2-byte fp16 best), over ONE flat
+        # bucket of the whole tree.  Needs a bound axis — the quantized
+        # schedule is explicit ppermutes; under plain pjit (unbound axis)
+        # the gradients are already globally reduced and there is no wire
+        # leg left to compress.
+        from .ops.collective import (block_dequantize, block_quantize,
+                                     quantized_ring_pmean)
 
         if axis_name is None or not _axis_bound(axis_name):
-            return grads
-        return jax.tree_util.tree_map(
-            lambda g: g if already_reduced(g)
-            else quantized_ring_pmean(g, axis_name, wire), grads)
+            return grads if residuals is None else (grads, residuals)
+        if all(already_reduced(g) for g in jax.tree_util.tree_leaves(grads)):
+            # provably-global grads: no wire leg left to compress, and
+            # EF would feed back an error that was never incurred
+            return grads if residuals is None else (grads, residuals)
+        from ._compat import axis_size as _axis_size
+        p = _axis_size(axis_name)
+        flat, unbucket = _bucket(grads)
+        if residuals is None:
+            if p == 1:
+                return grads
+            return unbucket(quantized_ring_pmean(
+                flat, axis_name, wire, quant_block, quant_pipeline))
+        # Error feedback: residuals arrive as this rank's (1, n) block of
+        # the (world, n) sharded state leaf (opt_state_partition_specs).
+        # A full-world block here means the state was fed in replicated —
+        # each rank would then update a DIFFERENT row of a supposedly
+        # replicated array and silently drop every other rank's error.
+        if p > 1 and residuals.shape[0] != 1:
+            raise ValueError(
+                f"error-feedback residual block has leading dim "
+                f"{residuals.shape[0]} (expected 1): the residual state "
+                f"leaf must be sharded over '{axis_name}' — build the "
+                "step with error_feedback=True (make_train_step) or "
+                "shard it via opt_state_partition_specs")
+        if residuals.shape[-1] != flat.shape[0]:
+            raise ValueError(
+                f"error-feedback residual holds {residuals.shape[-1]} "
+                f"elements but the gradient bucket holds {flat.shape[0]} "
+                "— the optimizer was initialized against different params")
+        if p == 1:
+            return grads, residuals
+        v = flat + residuals[0]
+        mean = unbucket(quantized_ring_pmean(
+            v, axis_name, wire, quant_block, quant_pipeline))
+        # e' = v - Dq(Q(v)): the first-quantization residual, computed
+        # with the SAME effective block the wire uses.  The ring clamps
+        # the block to the per-rank CHUNK (_ring_layout) — quantizing
+        # the residual at the raw quant_block instead would use coarser
+        # blocks whenever chunk < quant_block and re-inject gradient
+        # mass the fine-grained wire already delivered, a systematic
+        # training bias.  chunk_len is a multiple of eff_block, so the
+        # residual's block grid aligns with the wire's chunk grid.
+        from .ops.collective import _ring_layout
+        _, eff_block, _, _ = _ring_layout(
+            int(v.shape[0]), p, quant_block, quant_pipeline)
+        q, scales = block_quantize(v, wire, eff_block)
+        new_res = (v - block_dequantize(q, scales, n_elements=v.shape[0]))
+        return mean, new_res[None]
 
     def one(g):
         if already_reduced(g):
@@ -107,7 +205,37 @@ def compressed_mean(grads, axis_name: Optional[str], allreduce_grad_dtype=None):
     return jax.tree_util.tree_map(one, grads)
 
 
-def gradient_average(communicator=None, allreduce_grad_dtype=None) -> optax.GradientTransformation:
+def _resolve_world(communicator, world: Optional[int]) -> int:
+    """World size for EF residual allocation: explicit ``world=`` wins,
+    else the communicator's size.  Loud when neither is available —
+    silently allocating a 1-row residual for an 8-rank gang would shear
+    the state layout at first step."""
+    if world is not None:
+        return int(world)
+    size = getattr(communicator, "size", None)
+    if size is None:
+        raise ValueError(
+            "error_feedback=True needs the world size to allocate the "
+            "per-rank residual rows: pass a real communicator (xla/naive) "
+            "or world=<axis size> explicitly")
+    return int(size)
+
+
+def _ef_init(params, world: int) -> ErrorFeedbackState:
+    """Zero residuals: ONE (world, n_total) fp32 leaf over the bucketed
+    gradient size (``zero_fill`` semantics — the first step's wire
+    carries the raw gradients)."""
+    n_total = sum(int(jnp.size(l))
+                  for l in jax.tree_util.tree_leaves(params))
+    return ErrorFeedbackState(
+        residuals=jnp.zeros((int(world), n_total), jnp.float32))
+
+
+def gradient_average(communicator=None, allreduce_grad_dtype=None,
+                     error_feedback: bool = False,
+                     quant_block: int = DEFAULT_QUANT_BLOCK,
+                     quant_pipeline: int = 1,
+                     world: Optional[int] = None) -> optax.GradientTransformation:
     """An optax transform that means gradients across the communicator axis.
 
     Reference analog: ``communicator.multi_node_mean_grad(model)`` called by
@@ -120,16 +248,39 @@ def gradient_average(communicator=None, allreduce_grad_dtype=None) -> optax.Grad
     the same knob.  If gradients are already globally reduced (the default
     pjit/AD-inserted-psum path), the pmean is a trace-time identity and the
     cast merely simulates the precision loss.
+
+    ``error_feedback=True`` (int wire dtypes only) keeps the per-rank
+    quantization residual in the transform's state
+    (:class:`ErrorFeedbackState`) and folds it into the next step's
+    bucket — build the step with ``make_train_step(...,
+    error_feedback=True)`` so the residual leaf is sharded per rank.
     """
     axis_name = _resolve_axis(communicator)
+    if error_feedback:
+        if allreduce_grad_dtype is None or not jnp.issubdtype(
+                jnp.dtype(allreduce_grad_dtype), jnp.integer):
+            raise ValueError(
+                "error_feedback=True requires an integer "
+                f"allreduce_grad_dtype, got {allreduce_grad_dtype!r}")
+        ef_world = _resolve_world(communicator, world)
 
     def init_fn(params):
+        if error_feedback:
+            return _ef_init(params, ef_world)
         del params
         return optax.EmptyState()
 
     def update_fn(updates, state, params=None):
         del params
-        return compressed_mean(updates, axis_name, allreduce_grad_dtype), state
+        if error_feedback:
+            mean, new_res = compressed_mean(
+                updates, axis_name, allreduce_grad_dtype,
+                quant_block=quant_block, quant_pipeline=quant_pipeline,
+                residuals=state.residuals)
+            return mean, ErrorFeedbackState(residuals=new_res)
+        return compressed_mean(
+            updates, axis_name, allreduce_grad_dtype,
+            quant_block=quant_block, quant_pipeline=quant_pipeline), state
 
     return optax.GradientTransformation(init_fn, update_fn)
 
@@ -174,6 +325,10 @@ def hierarchical_gradient_average(chip_axis: str = "chip",
 class DoubleBufferState(NamedTuple):
     inner: optax.OptState
     stale_grads: optax.Updates  # averaged grads of the previous step
+    #: ErrorFeedbackState in the combined quantized+double-buffered mode
+    #: (the int8 ring of step k overlaps step k+1's forward/backward,
+    #: residuals ride along); empty tuple otherwise.
+    ef: Any = ()
 
 
 def create_multi_node_optimizer(
@@ -182,6 +337,10 @@ def create_multi_node_optimizer(
     double_buffering: bool = False,
     zero_fill: bool = True,
     allreduce_grad_dtype=None,
+    error_feedback: bool = False,
+    quant_block: int = DEFAULT_QUANT_BLOCK,
+    quant_pipeline: int = 1,
+    world: Optional[int] = None,
 ) -> optax.GradientTransformation:
     """Wrap ``actual_optimizer`` with cross-rank gradient averaging.
 
@@ -193,12 +352,35 @@ def create_multi_node_optimizer(
     ``'bfloat16'`` to halve gradient bytes on the wire — see
     :func:`gradient_average` for when the compression is physical vs
     simulated.
+
+    ``allreduce_grad_dtype='int8'`` runs the block-scaled quantized ring
+    over ONE bucket of the whole gradient tree (``quant_block`` elements
+    per fp32 scale, ``quant_pipeline`` sub-chunks per hop);
+    ``error_feedback=True`` adds the EF-SGD residual state
+    (:class:`ErrorFeedbackState` — build the step with
+    ``make_train_step(..., error_feedback=True)``).  Combining
+    ``double_buffering=True`` with the int8 wire is the
+    quantized+double-buffered mode: the ring of step ``k`` (1/4 the
+    bytes) overlaps step ``k+1``'s forward/backward, and the staleness
+    semantics are unchanged.
     """
     if not double_buffering:
         return optax.chain(
-            gradient_average(communicator, allreduce_grad_dtype), actual_optimizer)
+            gradient_average(communicator, allreduce_grad_dtype,
+                             error_feedback=error_feedback,
+                             quant_block=quant_block,
+                             quant_pipeline=quant_pipeline,
+                             world=world),
+            actual_optimizer)
 
     axis_name = _resolve_axis(communicator)
+    if error_feedback:
+        if allreduce_grad_dtype is None or not jnp.issubdtype(
+                jnp.dtype(allreduce_grad_dtype), jnp.integer):
+            raise ValueError(
+                "error_feedback=True requires an integer "
+                f"allreduce_grad_dtype, got {allreduce_grad_dtype!r}")
+        ef_world = _resolve_world(communicator, world)
 
     def init_fn(params):
         if not zero_fill:
@@ -206,14 +388,109 @@ def create_multi_node_optimizer(
                 "double_buffering requires zero_fill=True (matches reference: "
                 "grad buffers start zeroed)")
         zeros = jax.tree_util.tree_map(jax.numpy.zeros_like, params)
-        return DoubleBufferState(inner=actual_optimizer.init(params), stale_grads=zeros)
+        ef = _ef_init(params, ef_world) if error_feedback else ()
+        return DoubleBufferState(inner=actual_optimizer.init(params),
+                                 stale_grads=zeros, ef=ef)
 
     def update_fn(grads, state, params=None):
         # Average THIS step's grads (XLA overlaps the collective with
         # whatever compute follows), but apply the PREVIOUS step's average —
         # exactly the reference's 1-step staleness.
-        fresh = compressed_mean(grads, axis_name, allreduce_grad_dtype)
+        if error_feedback:
+            fresh, new_res = compressed_mean(
+                grads, axis_name, allreduce_grad_dtype,
+                quant_block=quant_block, quant_pipeline=quant_pipeline,
+                residuals=state.ef.residuals)
+            ef = ErrorFeedbackState(residuals=new_res)
+        else:
+            fresh = compressed_mean(
+                grads, axis_name, allreduce_grad_dtype,
+                quant_block=quant_block, quant_pipeline=quant_pipeline)
+            ef = state.ef
         updates, inner = actual_optimizer.update(state.stale_grads, state.inner, params)
-        return updates, DoubleBufferState(inner=inner, stale_grads=fresh)
+        return updates, DoubleBufferState(inner=inner, stale_grads=fresh,
+                                          ef=ef)
 
     return optax.GradientTransformation(init_fn, update_fn)
+
+
+# ---------------------------------------------------------------------------
+# Error-feedback state plumbing: step specs, checkpoint layout, elastic fold
+# ---------------------------------------------------------------------------
+
+def _is_ef(node) -> bool:
+    return isinstance(node, ErrorFeedbackState)
+
+
+def opt_state_partition_specs(opt_state, axis_name: str = DEFAULT_AXIS_NAME):
+    """Per-leaf ``PartitionSpec`` tree for an optimizer state holding
+    :class:`ErrorFeedbackState` nodes: residual leaves shard their
+    leading (rank) axis over ``axis_name``, everything else replicates.
+
+    This is what ``make_train_step(..., error_feedback=True)`` feeds
+    shard_map's ``in_specs``/``out_specs`` for the opt-state argument —
+    a plain ``P()`` would make every rank write its own row into a
+    "replicated" buffer and silently drop all but one rank's residuals.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    def one(node):
+        if _is_ef(node):
+            return ErrorFeedbackState(residuals=jax.tree_util.tree_map(
+                lambda _: P(axis_name), node.residuals))
+        return jax.tree_util.tree_map(lambda _: P(), node)
+
+    return jax.tree_util.tree_map(one, opt_state, is_leaf=_is_ef)
+
+
+def error_feedback_layout(opt_state, prefix: str = "") -> dict:
+    """v2-manifest checkpoint ``layout`` entries for the EF residual
+    leaves: dotted leaf path → ``["sharded", 0]`` (rows partition by
+    rank), merged into ``create_multi_node_checkpointer(layout=...)`` so
+    a multi-controller gang's shards carry the rank rows and
+    ``reshard_host`` reassembles them on elastic resume.  ``prefix``
+    prepends the opt state's own path inside the saved state tree."""
+    out = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(
+            opt_state, is_leaf=_is_ef)[0]:
+        if _is_ef(leaf):
+            for sub, _ in jax.tree_util.tree_flatten_with_path(leaf)[0]:
+                out[prefix + jax.tree_util.keystr(tuple(path) + tuple(sub))
+                    ] = ["sharded", 0]
+    return out
+
+
+def fold_error_feedback(residuals, new_world: int):
+    """Re-partition an EF residual array ``(old_world, n)`` for a new
+    world size, preserving the EF invariant: the applied correction mass
+    per step is ``(1/p)·Σ_r e_r``, so
+
+    * shrink (``new | old``): new rank ``r`` SUMS its inherited rows,
+      scaled by ``new/old`` — ``(1/p')·Σ e' == (1/p)·Σ e`` exactly (the
+      PR 13 live-shrink hook: call this in the ``heal()`` repartition
+      alongside the momentum blocks);
+    * growth (``old | new``): rows repeat onto the new ranks (each new
+      rank re-derives from its ancestor; the invariant again holds
+      exactly).
+
+    Non-divisible world changes raise — a fractional row split has no
+    exact invariant."""
+    import numpy as np
+
+    res = np.asarray(residuals)
+    old = res.shape[0]
+    new_world = int(new_world)
+    if new_world < 1:
+        raise ValueError(f"new_world must be >= 1, got {new_world}")
+    if old == new_world:
+        return res
+    if old % new_world == 0:
+        fold = old // new_world
+        return (res.reshape(new_world, fold, -1).sum(axis=1)
+                * (new_world / old)).astype(res.dtype)
+    if new_world % old == 0:
+        return np.repeat(res, new_world // old, axis=0)
+    raise ValueError(
+        f"cannot fold EF residuals {old} -> {new_world}: world sizes "
+        "must divide one another (shrink sums inherited rows, growth "
+        "repeats them)")

@@ -124,6 +124,7 @@ def make_train_step(
     allreduce_grad_dtype=None,
     grad_reduce: Optional[Callable] = None,
     grad_accum_steps: int = 1,
+    error_feedback: bool = False,
 ):
     """Build ``step(params, opt_state, batch) -> (params, opt_state, loss[, aux])``.
 
@@ -139,7 +140,17 @@ def make_train_step(
     allreduce_grad_dtype`` [uv]): the cross-rank gradient mean — the step's
     dominant communication — runs in that dtype on the wire, halving ICI/DCN
     gradient bytes for bf16, with params and the optimizer update staying at
-    full precision.
+    full precision.  ``'int8'`` runs the block-scaled quantized ring
+    (~1 byte/element; see ``ops.collective.quantized_ring_pmean``).
+
+    ``error_feedback=True`` (int8 wire + an optimizer built with the same
+    flag): the optimizer transform owns the wire collective — local
+    gradients flow to it uncorrected and its :class:`~chainermn_tpu
+    .optimizers.ErrorFeedbackState` residual rows shard per rank, so the
+    step binding derives per-leaf opt-state specs from the state's
+    structure (``opt_state_partition_specs``) at first call.  One
+    compiled program per opt-state STRUCTURE — value variants reuse it
+    (the ``train.quantized_step`` analysis entry point pins this).
 
     ``grad_accum_steps > 1`` splits each rank's local batch into that many
     microbatches and accumulates their gradients in fp32 via ``lax.scan``
@@ -152,6 +163,13 @@ def make_train_step(
 
     if grad_accum_steps < 1:
         raise ValueError(f"grad_accum_steps must be >= 1, got {grad_accum_steps}")
+    if error_feedback and grad_reduce is not None:
+        raise ValueError("error_feedback=True and grad_reduce are exclusive "
+                         "(the optimizer owns the wire collective under EF)")
+    # Under EF the builder must NOT pre-reduce: the optimizer's EF
+    # transform is the one wire collective (it needs the still-local
+    # grads to quantize WITH the residual correction).
+    builder_reduce = (lambda g: g) if error_feedback else grad_reduce
 
     def spmd(params, opt_state, batch):
         def local_loss(p, b):
@@ -163,12 +181,12 @@ def make_train_step(
         if grad_accum_steps == 1:
             (loss, aux), grads = _value_and_global_grads(
                 lambda p: local_loss(p, batch), params, axis_name,
-                allreduce_grad_dtype, grad_reduce)
+                allreduce_grad_dtype, builder_reduce)
         else:
             (loss, aux), grads = _accumulated_local_grads(
                 local_loss, params, batch, axis_name, grad_accum_steps)
-            if grad_reduce is not None:
-                grads = grad_reduce(grads)
+            if builder_reduce is not None:
+                grads = builder_reduce(grads)
             else:
                 grads = compressed_mean(grads, axis_name, allreduce_grad_dtype)
             loss = _col.pmean(loss, axis_name)
@@ -179,14 +197,43 @@ def make_train_step(
             return params, opt_state, loss, aux
         return params, opt_state, loss
 
-    out_specs = (P(), P(), P(), P()) if has_aux else (P(), P(), P())
-    smapped = shard_map(
-        spmd,
-        mesh=mesh,
-        in_specs=(P(), P(), P(axis_name)),
-        out_specs=out_specs,
-    )
-    return jax.jit(smapped, donate_argnums=(0, 1) if donate else ())
+    if not error_feedback:
+        out_specs = (P(), P(), P(), P()) if has_aux else (P(), P(), P())
+        smapped = shard_map(
+            spmd,
+            mesh=mesh,
+            in_specs=(P(), P(), P(axis_name)),
+            out_specs=out_specs,
+        )
+        return jax.jit(smapped, donate_argnums=(0, 1) if donate else ())
+
+    from .optimizers import opt_state_partition_specs
+
+    # EF residual leaves shard per rank, so the opt-state specs depend on
+    # the state's pytree STRUCTURE — bind shard_map lazily, one compiled
+    # program per structure (value variants share it; jit caches by the
+    # inner function identity held in `programs`).
+    programs = {}
+
+    def step(params, opt_state, batch):
+        key = jax.tree_util.tree_structure(opt_state)
+        fn = programs.get(key)
+        if fn is None:
+            ospecs = opt_state_partition_specs(opt_state, axis_name)
+            out_specs = ((P(), ospecs, P(), P()) if has_aux
+                         else (P(), ospecs, P()))
+            smapped = shard_map(
+                spmd, mesh=mesh,
+                in_specs=(P(), ospecs, P(axis_name)),
+                out_specs=out_specs)
+            fn = jax.jit(smapped, donate_argnums=(0, 1) if donate else ())
+            programs[key] = fn
+        return fn(params, opt_state, batch)
+
+    step._programs = programs  # the recompile probes read through this
+    step._cache_size = lambda: sum(
+        f._cache_size() for f in programs.values())
+    return step
 
 
 def make_flax_train_step(

@@ -59,7 +59,7 @@ METRICS_SCHEMA_PREFIX = "chainermn_tpu.metrics."
 _SKIP = re.compile(
     r"(^|/)(iteration|epoch|t|ts|rank|ranks|n|steps|reps|schema|kind|"
     r"wall_clock_s|elapsed_time|host_physical_cores|n_params|n_records|"
-    r"batch|headline_batch|grad_bytes(_fp32)?|record|seed|"
+    r"batch|headline_batch|grad_bytes(_fp32)?|record|seed|pipeline_k|"
     r"straggler_rank|merged_ranks|expected_ranks)($|/)")
 
 #: Lower-is-better key fingerprints (everything else: higher is better).
@@ -89,7 +89,14 @@ _SKIP = re.compile(
 #: the live-shrink wall (detection already gates via `detection`),
 #: the membership-agreement wall, and the steps a recovery replays
 #: (live shrink must hold 0) — more of any means the self-healing
-#: gang got slower or lossier, ISSUE 13).
+#: gang got slower or lossier, ISSUE 13;
+#: quantized_allreduce (ISSUE 14) keys ride the EXISTING patterns —
+#: direction-aware by construction: quantized_eff8 / quantized_db_eff8 /
+#: double_buffered_eff8 / grad_cosine carry no lower-is-better
+#: fingerprint so they gate higher-is-better (efficiency/accuracy up is
+#: good), while quant_wire_bytes / quant_predicted_bytes / scale_bytes
+#: match `bytes` and ef_loss_gap matches `gap`+`loss` — wire traffic
+#: and the EF-vs-fp32 training gap gate lower-is-better).
 _LOWER = re.compile(
     r"(time|_ms|ms_|/ms$|^ms$|latency|seconds|_s$|/s$|bytes|loss|"
     r"step_ms|gap|slowdown|imbalance|drift|anomal|dropped|findings|"

@@ -362,10 +362,16 @@ def test_every_collective_wrapper_books_through_accountant():
     # explicit non-collective allowlist)
     non_collectives = {"axis_index", "axis_size", "zeros_like_vma",
                        "pmean_if_bound",  # delegates to pmean
-                       # pure-arithmetic cost-model faces (ISSUE 6):
+                       # pure-arithmetic cost-model faces (ISSUE 6/14):
                        # consumed by analysis/shardflow.py and bench.py,
                        # they never touch the wire
-                       "collective_wire_cost", "quantized_ring_cost"}
+                       "collective_wire_cost", "quantized_ring_cost",
+                       "quantized_ring_static_groups",
+                       "choose_pipeline_depth",
+                       # the block quantizer pair (ISSUE 14): the ring's
+                       # and the EF residual's shared operator — pure
+                       # elementwise arithmetic
+                       "block_quantize", "block_dequantize"}
     for name, fn in vars(col).items():
         if name.startswith("_") or not inspect.isfunction(fn):
             continue

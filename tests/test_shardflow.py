@@ -271,50 +271,128 @@ class TestCostModel:
 
         c = quantized_ring_cost(1 << 20, 8, "int8")
         assert c["ledger_bytes"] == 1 << 20          # ~1 byte/element
-        # full schedule incl. scale traffic: 2(P-1) RS ppermute pairs +
-        # two AG ring all-reduces (buf_q, buf_s) at 2(P-1) each
-        assert c["messages"] == 6 * 7
+        # minimal ring decomposition: RS (P-1)·chunk + gather-ring
+        # all_gather (P-1)·chunk — the one-hot psum's 2× AG wire is gone
+        chunk = (1 << 20) // 8
+        assert c["wire_bytes"] == 2 * 7 * chunk
+        # fp32 scales: one per 256-block, both phases
+        assert c["scale_bytes"] == 2 * 7 * (chunk // 256) * 4
+        # RS: k packed sub-chunk ppermutes per hop (scales in-band);
+        # AG: one packed all_gather at P-1 ring messages
+        assert c["messages"] == 1 * 7 + 7
+        # pipelining multiplies RS messages, never wire bytes
+        c4 = quantized_ring_cost(1 << 20, 8, "int8", pipeline=4)
+        assert c4["wire_bytes"] == c["wire_bytes"]
+        assert c4["messages"] == 4 * 7 + 7
+        # block granularity only moves scale bytes
+        c64 = quantized_ring_cost(1 << 20, 8, "int8", block=64)
+        assert c64["wire_bytes"] == c["wire_bytes"]
+        assert c64["scale_bytes"] == 4 * c["scale_bytes"]
         assert quantized_ring_cost(64, 1)["wire_bytes"] == 0
+
+    def test_quantized_ring_static_groups_match_cost(self):
+        """The per-primitive groups a declaring entry point hands the
+        reconciliation sum to the same physical schedule the cost model
+        prices: int8 wire == ppermute-RS + all_gather-AG, scales ride
+        both phases."""
+        from chainermn_tpu.ops.collective import (quantized_ring_cost,
+                                                  quantized_ring_static_groups)
+
+        from chainermn_tpu.ops.collective import _ring_layout
+
+        for (n, p, b, k) in [(1 << 16, 8, 256, 1), (1000, 4, 64, 2),
+                             (64, 2, 256, 4)]:
+            chunk, _, nb_sub, kk = _ring_layout(n, p, b, k)
+            nb = kk * nb_sub
+            groups = quantized_ring_static_groups(n, p, "mn", "int8", b, k)
+            # LEDGER payload convention (per-call input bytes): RS books
+            # (p-1) hops of chunk int8 + nb fp32 scales; the AG
+            # all_gather books its per-rank input block once
+            assert groups == {
+                "ppermute@mn": (p - 1) * (chunk + nb * 4),
+                "all_gather@mn": chunk + nb * 4,
+            }
+            # and the cost model prices the same schedule physically:
+            # all_gather wire = payload × (p-1) on the gather ring
+            cost = quantized_ring_cost(n, p, "int8", b, k)
+            assert cost["wire_bytes"] == 2 * (p - 1) * chunk
+            assert cost["scale_bytes"] == 2 * (p - 1) * nb * 4
+        assert quantized_ring_static_groups(64, 1) == {}
+
+    def test_choose_pipeline_depth_scales_with_chunk(self):
+        from chainermn_tpu.ops.collective import choose_pipeline_depth
+
+        assert choose_pipeline_depth(1024) == 1       # alpha dominates
+        big = choose_pipeline_depth(64 << 20)
+        assert big >= 4                               # transfer dominates
+        assert choose_pipeline_depth(0) == 1
 
     @pytest.mark.slow
     def test_quantized_ring_model_matches_ledger_and_jaxpr(self):
-        """2 virtual CPU devices: the analytic model equals BOTH the
-        runtime ledger row (ledger convention) and the traced program's
-        int8 wire equations (physical convention) — the quantized path's
-        own static↔dynamic reconciliation."""
+        """The ISSUE 14 acceptance sweep, in one 8-virtual-device
+        subprocess: for EVERY (n_elements, axis_size, block, k) variant
+        the analytic model equals BOTH the runtime ledger row (ledger
+        convention) and the traced program's equations — int8 wire,
+        fp32 scale wire, per-primitive payload groups
+        (``quantized_ring_static_groups``) and message counts — the
+        quantized path's own static↔dynamic reconciliation."""
         code = textwrap.dedent("""
             import os
             os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
-                + " --xla_force_host_platform_device_count=2")
+                + " --xla_force_host_platform_device_count=8")
             os.environ["JAX_PLATFORMS"] = "cpu"
             import jax, numpy as np, jax.numpy as jnp
             from jax.sharding import PartitionSpec as P
             from chainermn_tpu._compat import shard_map
             from chainermn_tpu import topology, observability as obs
             from chainermn_tpu.ops import collective as C
-            from chainermn_tpu.ops.collective import quantized_ring_cost
+            from chainermn_tpu.ops.collective import (
+                quantized_ring_cost, quantized_ring_static_groups)
             from chainermn_tpu.observability.comm import get_accountant
             from chainermn_tpu.analysis import shardflow
 
-            mesh = topology.make_nd_mesh(("mn",), (2,), jax.devices()[:2])
-            fn = shard_map(lambda x: C.quantized_ring_pmean(x, "mn"),
-                           mesh=mesh, in_specs=(P(),), out_specs=P(),
-                           check_vma=False)
-            x = jnp.ones((64,), jnp.float32)
             obs.enable()
-            np.asarray(fn(x))
-            row = get_accountant().totals["quantized_ring_pmean@mn"]
-            cost = quantized_ring_cost(64, 2, "int8")
-            assert row["bytes"] == cost["ledger_bytes"], (row, cost)
+            acct = get_accountant()
+            for p in (2, 4, 8):
+                mesh = topology.make_nd_mesh(("mn",), (p,),
+                                             jax.devices()[:p])
+                for n in (64, 1000):
+                    for block in (32, 256):
+                        for k in (1, 2, 4):
+                            fn = shard_map(
+                                lambda x: C.quantized_ring_pmean(
+                                    x, "mn", "int8", block, k),
+                                mesh=mesh, in_specs=(P(),), out_specs=P(),
+                                check_vma=False)
+                            x = jnp.ones((n,), jnp.float32)
+                            acct.reset()
+                            np.asarray(fn(x))
+                            row = acct.totals["quantized_ring_pmean@mn"]
+                            cost = quantized_ring_cost(n, p, "int8",
+                                                       block, k)
+                            assert row["bytes"] == cost["ledger_bytes"], (
+                                p, n, block, k, row, cost)
 
-            jaxpr = jax.make_jaxpr(fn)(x)
-            costs = shardflow.static_costs(jaxpr)
-            int8_wire = sum(c.wire_bytes for c in costs
-                            if c.dtype == "int8")
-            f32_wire = sum(c.wire_bytes for c in costs
-                           if c.dtype == "float32")
-            assert int8_wire == cost["wire_bytes"], (int8_wire, cost)
-            assert f32_wire == cost["scale_bytes"], (f32_wire, cost)
+                            jaxpr = jax.make_jaxpr(fn)(x)
+                            costs = shardflow.static_costs(jaxpr)
+                            # the wire is ALL int8 (scales ride in-band,
+                            # bitcast behind each payload)
+                            int8_wire = sum(c.wire_bytes for c in costs
+                                            if c.dtype == "int8")
+                            f32_wire = sum(c.wire_bytes for c in costs
+                                           if c.dtype == "float32")
+                            msgs = sum(c.messages for c in costs)
+                            assert int8_wire == (cost["wire_bytes"]
+                                                 + cost["scale_bytes"]), (
+                                p, n, block, k, int8_wire, cost)
+                            assert f32_wire == 0, (p, n, block, k, f32_wire)
+                            assert msgs == cost["messages"], (
+                                p, n, block, k, msgs, cost)
+                            groups = shardflow.group_bytes(costs)
+                            want = quantized_ring_static_groups(
+                                n, p, "mn", "int8", block, k)
+                            assert groups == want, (
+                                p, n, block, k, groups, want)
             print("OK")
         """)
         r = subprocess.run([sys.executable, "-c", code], cwd=REPO,

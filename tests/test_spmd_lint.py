@@ -140,7 +140,10 @@ class TestRegistry:
                   if isinstance(n, ast.FunctionDef)
                   and not n.name.startswith("_")}
         expected = public - {"zeros_like_vma", "axis_index", "axis_size",
-                             "collective_wire_cost", "quantized_ring_cost"}
+                             "collective_wire_cost", "quantized_ring_cost",
+                             "quantized_ring_static_groups",
+                             "choose_pipeline_depth",
+                             "block_quantize", "block_dequantize"}
         assert expected == reg.ops_collectives
         assert "quantized_ring_pmean" in reg.ops_collectives
         assert "hierarchical_pmean" in reg.ops_collectives
