@@ -62,12 +62,16 @@ from .shardflow import (  # noqa: F401  (stdlib-only at import time)
     SHARDFLOW_RULES,
     ShardflowReport,
 )
+from .concurrency import CONCURRENCY_RULES  # noqa: F401  (stdlib-only)
+from .protocol import ALL_MODELS as PROTOCOL_MODELS  # noqa: F401
 
 __all__ = [
     "AST_RULES",
     "Baseline",
+    "CONCURRENCY_RULES",
     "CollectiveRegistry",
     "Finding",
+    "PROTOCOL_MODELS",
     "SEVERITIES",
     "SHARDFLOW_RULES",
     "ShardflowReport",

@@ -25,17 +25,24 @@ import sys
 from typing import List, Optional
 
 from .ast_engine import AST_RULES, analyze_paths
-from .findings import (BASELINE_FILENAME, Baseline, Finding, find_baseline,
-                       load_baseline)
+from .baseline import BaselineGate
+from .concurrency import (CONCURRENCY_BASELINE_FILENAME,
+                          CONCURRENCY_RULES)
+from .concurrency import analyze_paths as analyze_concurrency
+from .findings import BASELINE_FILENAME, Finding, find_baseline
 from .registry import default_registry
 
 SCHEMA = "chainermn_tpu.spmd_lint.v1"
+
+#: ``--rules concurrency`` selects the whole lock-discipline family.
+RULE_FAMILIES = {"concurrency": tuple(sorted(CONCURRENCY_RULES))}
 
 
 def _all_rules():
     from .jaxpr_engine import JAXPR_RULES
     out = dict(AST_RULES)
     out.update(JAXPR_RULES)
+    out.update(CONCURRENCY_RULES)
     return out
 
 
@@ -84,6 +91,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.list_rules:
         for rule, (sev, desc) in sorted(_all_rules().items()):
             print(f"{rule:24s} {sev:8s} {desc}")
+        for fam, members in sorted(RULE_FAMILIES.items()):
+            print(f"{fam:24s} family   = {', '.join(members)}")
         return 0
 
     paths = args.paths or [_package_dir()]
@@ -93,9 +102,13 @@ def main(argv: Optional[List[str]] = None) -> int:
               file=sys.stderr)
         return 2
 
-    rules = ([r.strip() for r in args.rules.split(",") if r.strip()]
-             if args.rules else None)
-    if rules:
+    raw_rules = ([r.strip() for r in args.rules.split(",") if r.strip()]
+                 if args.rules else None)
+    rules: Optional[List[str]] = None
+    if raw_rules:
+        rules = []
+        for r in raw_rules:
+            rules.extend(RULE_FAMILIES.get(r, (r,)))
         unknown = set(rules) - set(_all_rules())
         if unknown:
             print(f"error: unknown rule(s): {', '.join(sorted(unknown))} "
@@ -106,11 +119,29 @@ def main(argv: Optional[List[str]] = None) -> int:
               file=sys.stderr)
         return 2
 
+    # the concurrency family runs alongside the SPMD rules (own engine,
+    # own baseline file); a pure-concurrency --rules selection skips the
+    # AST/jaxpr engines entirely
+    conc_only = rules is not None and all(
+        r in CONCURRENCY_RULES for r in rules)
+    run_conc = rules is None or any(r in CONCURRENCY_RULES
+                                    for r in rules)
+
     registry = default_registry()
-    findings = analyze_paths(paths, registry=registry, rules=rules)
+    findings = ([] if conc_only
+                else analyze_paths(paths, registry=registry,
+                                   rules=rules))
+    conc_findings: List[Finding] = []
+    if run_conc:
+        conc_findings = analyze_concurrency(paths, rules=rules)
+        if not conc_only:
+            # both engines parsed the same files: keep the AST
+            # engine's parse-error as the canonical one
+            conc_findings = [f for f in conc_findings
+                             if f.rule != "parse-error"]
 
     reports = []
-    if not args.no_jaxpr:
+    if not args.no_jaxpr and not conc_only:
         try:
             from .jaxpr_engine import check_entrypoints
             eps = None
@@ -137,7 +168,6 @@ def main(argv: Optional[List[str]] = None) -> int:
     # path (the checked-in layout), else at the scanned paths' common
     # ancestor — NEVER at a root that forces "../" segments, which would
     # bake the checkout's absolute location into fingerprints ----
-    baseline: Optional[Baseline] = None
     bl_path = args.baseline or find_baseline(paths[0])
     abs_paths = [os.path.abspath(p) for p in paths]
     common = os.path.commonpath(abs_paths)
@@ -148,57 +178,84 @@ def main(argv: Optional[List[str]] = None) -> int:
         bl_dir = os.path.dirname(os.path.abspath(bl_path))
         if os.path.commonpath([bl_dir, common]) == bl_dir:
             root = bl_dir
+    gate = BaselineGate(bl_path, enabled=not args.no_baseline)
+    conc_gate = BaselineGate.resolve(
+        None, paths[0], CONCURRENCY_BASELINE_FILENAME,
+        enabled=not args.no_baseline)
+    # each family anchors its findings at ITS OWN baseline's directory
+    # (falling back to the scan root): an `--baseline` redirect of the
+    # SPMD file must not re-root the concurrency fingerprints — or a
+    # fixture-dir --fix-baseline would resolve the repo keepers'
+    # relative paths against the wrong root and wipe them as in-scope
+    conc_root = root
+    if conc_gate.path:
+        cd = os.path.dirname(os.path.abspath(conc_gate.path))
+        if os.path.commonpath([cd, common]) == cd:
+            conc_root = cd
     for f in findings:
         if f.path and not f.path.startswith("entrypoint:"):
             f.path = os.path.relpath(os.path.abspath(f.path), root)
-
-    if not args.no_baseline and bl_path and os.path.exists(bl_path):
-        try:
-            baseline = load_baseline(bl_path)
-        except (OSError, ValueError, KeyError) as e:
-            print(f"error: unreadable baseline {bl_path}: {e}",
-                  file=sys.stderr)
+    for f in conc_findings:
+        if f.path:
+            f.path = os.path.relpath(os.path.abspath(f.path), conc_root)
+    for g in (gate, conc_gate):
+        err = g.load()
+        if err:
+            print(f"error: {err}", file=sys.stderr)
             return 2
 
     if args.fix_baseline:
-        target = bl_path or os.path.join(root, BASELINE_FILENAME)
-        new_bl = Baseline.from_findings(findings, path=target)
-        carried = 0
-        if baseline is not None:
-            # regeneration is scoped to THIS invocation: entries for
-            # paths not scanned, rules filtered out, or entry points not
-            # run (--no-jaxpr) are carried over untouched — a partial
-            # `--fix-baseline chainermn_tpu/` must not wipe the
-            # examples/ keepers
-            def in_scope(entry) -> bool:
-                p = entry["path"]
-                if p.startswith("entrypoint:"):
-                    if args.entry and p[len("entrypoint:"):] not in args.entry:
-                        return False  # --entry: unselected entries carry over
-                    return not args.no_jaxpr and (
-                        rules is None or entry["rule"] in rules
-                        or entry["rule"] == "entrypoint-error")
-                if rules is not None and entry["rule"] not in rules \
-                        and entry["rule"] != "parse-error":
-                    return False
-                ap = os.path.normpath(os.path.join(root, p))
-                return any(ap == sp or ap.startswith(sp + os.sep)
-                           for sp in abs_paths)
+        # regeneration is scoped to THIS invocation: entries for paths
+        # not scanned, rules filtered out, or entry points not run
+        # (--no-jaxpr) are carried over untouched — a partial
+        # `--fix-baseline chainermn_tpu/` must not wipe the examples/
+        # keepers.  Each family regenerates its OWN baseline file.
+        def path_in_scope(entry, anchor) -> bool:
+            ap = os.path.normpath(os.path.join(anchor, entry["path"]))
+            return any(ap == sp or ap.startswith(sp + os.sep)
+                       for sp in abs_paths)
 
-            for fp, e in baseline.entries.items():
-                if not in_scope(e) and fp not in new_bl.entries:
-                    new_bl.entries[fp] = dict(e)
-                    carried += 1
-            new_bl.merge_comments_from(baseline)
-        new_bl.save()
-        extra = f", {carried} out-of-scope carried over" if carried else ""
-        print(f"baseline written: {target} ({len(new_bl.entries)} "
-              f"accepted findings{extra})", file=sys.stderr)
+        def in_scope(entry) -> bool:
+            p = entry["path"]
+            if p.startswith("entrypoint:"):
+                if args.entry and p[len("entrypoint:"):] not in args.entry:
+                    return False  # --entry: unselected entries carry over
+                return not args.no_jaxpr and (
+                    rules is None or entry["rule"] in rules
+                    or entry["rule"] == "entrypoint-error")
+            if rules is not None and entry["rule"] not in rules \
+                    and entry["rule"] != "parse-error":
+                return False
+            return path_in_scope(entry, root)
+
+        def conc_in_scope(entry) -> bool:
+            if entry["rule"] == "parse-error" and not conc_only:
+                # the combined run dedups parse-errors into the SPMD
+                # family (they are stripped from conc_findings above);
+                # a parse-error the STANDALONE concurrency runner
+                # baselined must carry over, not be wiped as in-scope
+                return False
+            if rules is not None and entry["rule"] not in rules \
+                    and entry["rule"] != "parse-error":
+                return False
+            return path_in_scope(entry, conc_root)
+
+        if not conc_only:
+            gate.fix(findings, in_scope=in_scope,
+                     default_target=os.path.join(root,
+                                                 BASELINE_FILENAME))
+        if run_conc:
+            conc_gate.fix(
+                conc_findings, in_scope=conc_in_scope,
+                default_target=os.path.join(
+                    conc_root, CONCURRENCY_BASELINE_FILENAME))
         return 0
 
-    accepted: List[Finding] = []
-    if baseline is not None:
-        findings, accepted = baseline.filter(findings)
+    findings, accepted = gate.filter(findings)
+    conc_new, conc_accepted = conc_gate.filter(conc_findings)
+    findings = sorted(findings + conc_new,
+                      key=lambda f: (f.path, f.line, f.rule))
+    accepted = accepted + conc_accepted
 
     if args.json:
         doc = {
@@ -206,7 +263,12 @@ def main(argv: Optional[List[str]] = None) -> int:
             "paths": [os.path.relpath(os.path.abspath(p), root)
                       for p in paths],
             "baseline": (os.path.relpath(bl_path, root)
-                         if bl_path and baseline is not None else None),
+                         if bl_path and gate.baseline is not None
+                         else None),
+            "concurrency_baseline": (
+                os.path.relpath(conc_gate.path, root)
+                if conc_gate.path and conc_gate.baseline is not None
+                else None),
             "n_accepted_by_baseline": len(accepted),
             "findings": [f.to_dict() for f in findings],
             "entrypoints": [

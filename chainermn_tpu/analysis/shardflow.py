@@ -952,7 +952,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     import json
     import sys
 
-    from .findings import Baseline, load_baseline
+    from .baseline import BaselineGate
 
     p = argparse.ArgumentParser(
         prog="python scripts/shardflow_report.py",
@@ -992,41 +992,26 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     findings, reports = analyze_entrypoints(eps)
 
-    bl_path = args.baseline or find_shardflow_baseline()
-    baseline = None
-    if not args.no_baseline and bl_path and os.path.exists(bl_path):
-        try:
-            baseline = load_baseline(bl_path)
-        except (OSError, ValueError, KeyError) as e:
-            print(f"error: unreadable baseline {bl_path}: {e}",
-                  file=sys.stderr)
-            return 2
+    gate = BaselineGate(args.baseline or find_shardflow_baseline(),
+                        enabled=not args.no_baseline)
+    err = gate.load()
+    if err:
+        print(f"error: {err}", file=sys.stderr)
+        return 2
 
     if args.fix_baseline:
-        target = bl_path or SHARDFLOW_BASELINE_FILENAME
-        new_bl = Baseline.from_findings(findings, path=target)
-        carried = 0
-        if baseline is not None:
-            analyzed = {f"entrypoint:{r.name}" for r in reports}
-            for fp, e in baseline.entries.items():
-                if e["path"] not in analyzed and fp not in new_bl.entries:
-                    new_bl.entries[fp] = dict(e)
-                    carried += 1
-            new_bl.merge_comments_from(baseline)
-        new_bl.save()
-        extra = f", {carried} out-of-scope carried over" if carried else ""
-        print(f"baseline written: {target} ({len(new_bl.entries)} "
-              f"accepted findings{extra})", file=sys.stderr)
+        analyzed = {f"entrypoint:{r.name}" for r in reports}
+        gate.fix(findings,
+                 in_scope=lambda e: e["path"] in analyzed,
+                 default_target=SHARDFLOW_BASELINE_FILENAME)
         return 0
 
-    accepted: List[Finding] = []
-    if baseline is not None:
-        findings, accepted = baseline.filter(findings)
+    findings, accepted = gate.filter(findings)
 
     if args.json:
         print(json.dumps({
             "schema": SHARDFLOW_SCHEMA,
-            "baseline": bl_path if baseline is not None else None,
+            "baseline": gate.path if gate.baseline is not None else None,
             "n_accepted_by_baseline": len(accepted),
             "findings": [f.to_dict() for f in findings],
             "reports": [r.to_dict() for r in reports],

@@ -191,8 +191,11 @@ class CommAccountant:
         if not trace.get_tracer().enabled:
             # no report for an untraced step — and clear any earlier one
             # so consumers (StepBreakdownReport) don't republish frozen
-            # values forever after tracing is disabled mid-run
-            self.last_step_report = None
+            # values forever after tracing is disabled mid-run (locked:
+            # the traced finalize writes it under _lock on another
+            # thread's step bracket)
+            with self._lock:
+                self.last_step_report = None
             yield None
             return
         with self._lock:

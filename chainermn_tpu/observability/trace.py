@@ -157,7 +157,8 @@ class Tracer:
         return tid
 
     def _append(self, ev: Dict[str, Any]) -> None:
-        # callers hold self._lock
+        # holds-lock: _lock  (callers serialize; the concurrency lint
+        # verifies every intra-class call site against this contract)
         if len(self._events) >= self.max_events:
             self._dropped += 1
             return

@@ -570,11 +570,15 @@ class ServingEngine:
             self.goodput.add("host", t_tick - t_host)
             # inter-tick gap: what a decoding request waits between its
             # tokens — includes any prefill that ran above (the fused
-            # engine's tail; see the disagg bench section, ISSUE 9)
-            if self._last_tick_start is not None:
-                self._tick_gap_ms.add((t_tick - self._last_tick_start)
-                                      * 1e3)
-            self._last_tick_start = t_tick
+            # engine's tail; see the disagg bench section, ISSUE 9).
+            # Locked with reset_stats: a bench warm-up reset racing this
+            # read-modify-write could book one warm-up gap into the
+            # gated window (the unguarded-shared-write lint class)
+            with self._lock:
+                if self._last_tick_start is not None:
+                    self._tick_gap_ms.add(
+                        (t_tick - self._last_tick_start) * 1e3)
+                self._last_tick_start = t_tick
             tick_bucket = ("compile" if self.engine.tick_calls == 0
                            else "compute")
             t_tick_us = obs.now_us()
@@ -607,7 +611,8 @@ class ServingEngine:
         else:
             # an idle step breaks the tick cadence: the next gap would
             # measure stall, not inter-token latency — restart the clock
-            self._last_tick_start = None
+            with self._lock:
+                self._last_tick_start = None
 
         with self._lock:
             self._ticks += 1
@@ -626,21 +631,26 @@ class ServingEngine:
         if self.slo is not None and active:
             # per-step instantaneous rate: tokens since the previous
             # observation over the elapsed gap (idle steps don't count
-            # — zero demand is not an SLO violation)
-            last_tok, last_t = self._slo_last
+            # — zero demand is not an SLO violation).  The read-modify-
+            # write of _slo_last is atomic vs reset_stats; the SLO
+            # observation happens OUTSIDE the lock (SLOTracker has its
+            # own — nesting them would order the two locks)
             now_t = time.monotonic()
+            with self._lock:
+                last_tok, last_t = self._slo_last
+                emitted = self._tokens_emitted
+                self._slo_last = (emitted, now_t)
             dt = now_t - last_t
             if dt > 0:
-                self.slo.observe_throughput(
-                    (self._tokens_emitted - last_tok) / dt)
-            self._slo_last = (self._tokens_emitted, now_t)
+                self.slo.observe_throughput((emitted - last_tok) / dt)
         if self.metrics_writer is not None:
             self.metrics_writer.write(
                 {f"serving/{k}": v for k, v in stats.items()},
                 kind="serving_step")
         t_end = time.monotonic()
         self.goodput.add("host", t_end - t_host)
-        self._last_step_end = t_end
+        with self._lock:
+            self._last_step_end = t_end
         # phase stamp: the ring's "last completed unit of work" marker
         # (what explain_bundle names when a serve loop dies mid-flight)
         _flight.note("phase", name="serving/step", tick=self._ticks,

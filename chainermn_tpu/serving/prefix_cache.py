@@ -312,7 +312,7 @@ class PrefixCache:
         self._by_slot[slot] = entry
         self.insertions += 1
         if self.on_insert is not None:
-            self.on_insert(entry)
+            self.on_insert(entry)   # holds-lock: _lock
         # a strictly-shorter entry whose seq prefixes the new one is
         # subsumed: every hit it could serve, the new entry serves
         # better.  Evict the unpinned ones now (their slot frees up).
@@ -369,7 +369,7 @@ class PrefixCache:
         if self.on_evict is not None:
             # BEFORE the slot goes back: the spill tier packs the rows
             # while the slot still holds them (evict_slot resets pos)
-            self.on_evict(entry)
+            self.on_evict(entry)   # holds-lock: _lock
         del self._entries[entry.id]
         self._by_slot.pop(entry.slot, None)
         node = entry.node

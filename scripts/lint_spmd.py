@@ -17,6 +17,9 @@ Usage::
     python scripts/lint_spmd.py --fix-baseline chainermn_tpu/   # accept
     python scripts/lint_spmd.py --entry train.step chainermn_tpu/train.py
     #   ^ jaxpr checks on ONE registered entry point (fast iteration)
+    python scripts/lint_spmd.py --no-jaxpr --rules concurrency chainermn_tpu/
+    #   ^ the ISSUE 15 lock-discipline family alone (own baseline:
+    #     .concurrency-baseline.json; docs/ANALYSIS.md)
 """
 
 import importlib.util

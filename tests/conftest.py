@@ -23,7 +23,27 @@ jax.config.update("jax_platforms", "cpu")
 # JAX (`from jax import shard_map`) collect on the container's floor.
 import chainermn_tpu  # noqa: E402,F401
 
+# Opt-in runtime lock-order cross-check (ISSUE 15 satellite): with
+# CHAINERMN_TPU_LOCK_ASSERT=1 every threading.Lock/RLock created inside
+# the package is replaced by a recording proxy, and the session-end
+# fixture below asserts the UNION of the observed acquisition orders
+# with the static lock graph stays acyclic — dynamic orders the AST
+# cannot see (serving engines, routers, heartbeat threads in the
+# serving test modules) are caught here.  Installed at import time so
+# it precedes every lock construction in the tests.
+from chainermn_tpu.analysis import lockassert as _lockassert  # noqa: E402
+
+_LOCK_RECORDER = _lockassert.install_from_env()
+
 import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _lock_assert_gate():
+    yield
+    if _LOCK_RECORDER is not None:
+        _LOCK_RECORDER.uninstall()
+        _lockassert.assert_consistent(_LOCK_RECORDER)
 
 
 @pytest.fixture(scope="session")

@@ -1,0 +1,29 @@
+"""BAD: the PR 10 beat-after-release lease resurrection, distilled.
+
+``release`` latches the publisher closed under the lock, but ``beat``
+checks the latch and mints its seq BARE — a beat racing the release
+can observe ``_released`` False, lose the CPU, and publish AFTER the
+lease was deleted, resurrecting a drained worker's lease.
+"""
+
+import threading
+
+
+class Publisher:
+    def __init__(self, store):
+        self.store = store
+        self._lock = threading.Lock()
+        self._released = False
+        self.seq = 0
+
+    def release(self):
+        with self._lock:
+            self._released = True
+            self.seq = -1
+
+    def beat(self):
+        if self._released:
+            return None
+        self.seq += 1          # unguarded-shared-write fires here
+        self.store["lease"] = self.seq
+        return self.seq

@@ -1,0 +1,26 @@
+"""CLEAN: the latch check, the seq mint, and the publish are one
+critical section — a racing release either runs before (beat refused)
+or after (lease deleted after the beat) — never interleaved."""
+
+import threading
+
+
+class Publisher:
+    def __init__(self, store):
+        self.store = store
+        self._lock = threading.Lock()
+        self._released = False
+        self.seq = 0
+
+    def release(self):
+        with self._lock:
+            self._released = True
+            self.seq = -1
+
+    def beat(self):
+        with self._lock:
+            if self._released:
+                return None
+            self.seq += 1
+            self.store["lease"] = self.seq
+            return self.seq

@@ -1,0 +1,26 @@
+"""BAD: running the compiled tick program while holding the stats
+lock — a multi-millisecond device program inside a lock every metrics
+reader contends on (worse on first call: the jit compile happens under
+the lock too).
+"""
+
+import threading
+
+from jax import jit
+
+
+def _tick_impl(state):
+    return state
+
+
+class Engine:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._tick = jit(_tick_impl)
+        self.ticks = 0
+
+    def step(self, state):
+        with self._lock:
+            out = self._tick(state)      # blocking-call-under-lock
+            self.ticks += 1
+        return out

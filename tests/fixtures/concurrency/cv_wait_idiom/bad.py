@@ -1,0 +1,18 @@
+"""BAD: waiting on an Event while holding an unrelated lock — unlike a
+condition-variable wait, ``Event.wait`` does NOT release anything: the
+setter may need the held lock to make the event fire, a deadlock.
+"""
+
+import threading
+
+
+class Gate:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._ready = threading.Event()
+        self.opens = 0
+
+    def wait_open(self):
+        with self._lock:
+            self._ready.wait(1.0)     # blocking-call-under-lock
+            self.opens += 1

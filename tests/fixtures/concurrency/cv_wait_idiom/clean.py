@@ -1,0 +1,22 @@
+"""CLEAN: the condition-variable idiom — ``cv.wait()`` while holding
+THAT cv is the one legal blocking-wait-under-lock: wait atomically
+releases the lock and re-acquires it on wakeup (the KvTransferPlane
+reservation shape)."""
+
+import threading
+
+
+class Gate:
+    def __init__(self):
+        self._cv = threading.Condition()
+        self.opens = 0
+
+    def wait_open(self):
+        with self._cv:
+            while self.opens == 0:
+                self._cv.wait(1.0)
+
+    def open(self):
+        with self._cv:
+            self.opens += 1
+            self._cv.notify_all()

@@ -1,0 +1,21 @@
+"""BAD: the PR 12 PrefixCache hook shape WITHOUT the declared
+contract — a user-supplied ``on_evict`` invoked while the cache lock is
+held, undeclared.  A hook that takes any lock orderable against this
+one deadlocks; the contract comment is what makes that auditable.
+"""
+
+import threading
+
+
+class Cache:
+    def __init__(self, on_evict=None):
+        self._lock = threading.Lock()
+        self.entries = {}
+        self.on_evict = on_evict
+
+    def evict(self, key):
+        with self._lock:
+            entry = self.entries.pop(key, None)
+            if entry is not None and self.on_evict is not None:
+                self.on_evict(entry)   # callback-under-lock-contract
+            return entry

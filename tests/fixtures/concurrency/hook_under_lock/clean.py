@@ -1,0 +1,20 @@
+"""CLEAN: same under-lock invocation, DECLARED — the two-sided
+contract: the pre-evict hook must run while the rows still exist, so
+the hold is by design and the comment makes it machine-checkable
+(hooks must never take a lock held while calling into this class)."""
+
+import threading
+
+
+class Cache:
+    def __init__(self, on_evict=None):
+        self._lock = threading.Lock()
+        self.entries = {}
+        self.on_evict = on_evict
+
+    def evict(self, key):
+        with self._lock:
+            entry = self.entries.pop(key, None)
+            if entry is not None and self.on_evict is not None:
+                self.on_evict(entry)   # holds-lock: _lock
+            return entry

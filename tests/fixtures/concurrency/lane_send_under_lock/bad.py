@@ -1,0 +1,25 @@
+"""BAD: retrying lane I/O inside the registration critical section —
+every submit thread AND the supervisor stall behind one slow/retrying
+lane put (the shape the PR 10 router kept OUT of ``_lock``: only the
+seq-critical MailboxSender holds a lock across its put, and that one
+is a commented baseline keeper).
+"""
+
+import threading
+
+
+def lane_call(lane, fn, config=None):
+    return fn()
+
+
+class Dispatcher:
+    def __init__(self, store):
+        self.store = store
+        self._lock = threading.Lock()
+        self.inflight = {}
+
+    def submit(self, trace_id, payload):
+        with self._lock:
+            self.inflight[trace_id] = payload
+            lane_call(f"ctl/{trace_id}",      # blocking-call-under-lock
+                      lambda: self.store.put(trace_id, payload))

@@ -1,0 +1,26 @@
+"""CLEAN: register under the lock, do the lane I/O after — with the
+pop-or-bail rollback for a failed send (the shipped submit shape)."""
+
+import threading
+
+
+def lane_call(lane, fn, config=None):
+    return fn()
+
+
+class Dispatcher:
+    def __init__(self, store):
+        self.store = store
+        self._lock = threading.Lock()
+        self.inflight = {}
+
+    def submit(self, trace_id, payload):
+        with self._lock:
+            self.inflight[trace_id] = payload
+        try:
+            lane_call(f"ctl/{trace_id}",
+                      lambda: self.store.put(trace_id, payload))
+        except Exception:
+            with self._lock:
+                self.inflight.pop(trace_id, None)
+            raise

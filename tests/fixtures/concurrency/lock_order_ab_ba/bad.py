@@ -1,0 +1,27 @@
+"""BAD: classic AB/BA inversion — the dispatch path takes the
+registration lock then the stats lock, the metrics path takes them in
+the OPPOSITE order.  Two threads entering from opposite ends hold one
+lock each and wait forever for the other.
+"""
+
+import threading
+
+
+class Fleet:
+    def __init__(self):
+        self._reg_lock = threading.Lock()
+        self._stats_lock = threading.Lock()
+        self.inflight = {}
+        self.tokens = 0
+
+    def dispatch(self, trace_id):
+        with self._reg_lock:
+            self.inflight[trace_id] = True
+            with self._stats_lock:
+                self.tokens += 1
+
+    def metrics(self):
+        with self._stats_lock:
+            n = self.tokens
+            with self._reg_lock:      # lock-order-inversion fires
+                return n, len(self.inflight)

@@ -1,0 +1,23 @@
+"""CLEAN: one global order — whoever needs both locks takes the
+registration lock first, always."""
+
+import threading
+
+
+class Fleet:
+    def __init__(self):
+        self._reg_lock = threading.Lock()
+        self._stats_lock = threading.Lock()
+        self.inflight = {}
+        self.tokens = 0
+
+    def dispatch(self, trace_id):
+        with self._reg_lock:
+            self.inflight[trace_id] = True
+            with self._stats_lock:
+                self.tokens += 1
+
+    def metrics(self):
+        with self._reg_lock:
+            with self._stats_lock:
+                return self.tokens, len(self.inflight)

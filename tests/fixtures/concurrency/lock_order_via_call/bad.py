@@ -1,0 +1,34 @@
+"""BAD: the inversion the eye misses — no method nests the two ``with``
+blocks directly; the cycle only exists through intra-class calls
+(submit holds the registry lock and calls into the cache face, the
+sweep holds the cache lock and calls back into the registry face).
+This is the sweep-vs-blocked-send shape from the PR 10 review round.
+"""
+
+import threading
+
+
+class Router:
+    def __init__(self):
+        self._reg = threading.Lock()
+        self._cache = threading.Lock()
+        self.entries = {}
+        self.index = {}
+
+    def _index_insert(self, key):
+        with self._cache:
+            self.index[key] = True
+
+    def _entry_drop(self, key):
+        with self._reg:
+            self.entries.pop(key, None)
+
+    def submit(self, key):
+        with self._reg:
+            self.entries[key] = True
+            self._index_insert(key)    # holds _reg -> takes _cache
+
+    def sweep(self, key):
+        with self._cache:
+            self.index.pop(key, None)
+            self._entry_drop(key)      # holds _cache -> takes _reg

@@ -1,0 +1,33 @@
+"""CLEAN: the sweep SNAPSHOTS under the cache lock and mutates the
+registry AFTER releasing it — no path ever holds both locks in the
+reverse order (the shipped _sweep_orphan_tags shape)."""
+
+import threading
+
+
+class Router:
+    def __init__(self):
+        self._reg = threading.Lock()
+        self._cache = threading.Lock()
+        self.entries = {}
+        self.index = {}
+
+    def _index_insert(self, key):
+        with self._cache:
+            self.index[key] = True
+
+    def _entry_drop(self, key):
+        with self._reg:
+            self.entries.pop(key, None)
+
+    def submit(self, key):
+        with self._reg:
+            self.entries[key] = True
+        self._index_insert(key)
+
+    def sweep(self, key):
+        with self._cache:
+            stale = key in self.index
+            self.index.pop(key, None)
+        if stale:
+            self._entry_drop(key)

@@ -1,0 +1,24 @@
+"""BAD: a non-reentrant lock re-acquired through an intra-class call
+chain — ``insert`` holds the lock and calls ``evict`` through the same
+locked public face; with a plain ``Lock`` the thread deadlocks against
+itself the first time the path runs.
+"""
+
+import threading
+
+
+class Cache:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.entries = {}
+
+    def evict(self, key):
+        with self._lock:
+            self.entries.pop(key, None)
+
+    def insert(self, key, value):
+        with self._lock:
+            self.entries[key] = value
+            for old in list(self.entries):
+                if old != key:
+                    self.evict(old)    # re-acquires the held Lock

@@ -1,0 +1,22 @@
+"""CLEAN: the PrefixCache answer — the lock is an RLock precisely
+because ``insert`` evicts subsumed entries through the same public
+face (documented on the shipped class)."""
+
+import threading
+
+
+class Cache:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self.entries = {}
+
+    def evict(self, key):
+        with self._lock:
+            self.entries.pop(key, None)
+
+    def insert(self, key, value):
+        with self._lock:
+            self.entries[key] = value
+            for old in list(self.entries):
+                if old != key:
+                    self.evict(old)

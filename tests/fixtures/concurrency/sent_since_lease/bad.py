@@ -1,0 +1,21 @@
+"""BAD: the PR 10 follow-up ``sent_since_lease`` lost-update race,
+distilled.  Submit threads bump the depth estimate bare while the
+supervisor resets it under the registration lock — a lost increment
+undercounts the worker's queue depth and over-admits full queues (the
+same shape as the PR 9 cross-thread goodput double-booking).
+"""
+
+import threading
+
+
+class Router:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.sent_since_lease = 0
+
+    def observe_lease(self):
+        with self._lock:
+            self.sent_since_lease = 0
+
+    def submit(self):
+        self.sent_since_lease += 1     # unguarded-shared-write fires

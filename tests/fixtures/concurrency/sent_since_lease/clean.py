@@ -1,0 +1,18 @@
+"""CLEAN: every read-modify-write of the shared counter under the one
+registration lock (the shipped FleetRouter shape)."""
+
+import threading
+
+
+class Router:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.sent_since_lease = 0
+
+    def observe_lease(self):
+        with self._lock:
+            self.sent_since_lease = 0
+
+    def submit(self):
+        with self._lock:
+            self.sent_since_lease += 1

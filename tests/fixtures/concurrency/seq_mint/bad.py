@@ -1,0 +1,26 @@
+"""BAD: the PR 10 MailboxSender seq-mint race, distilled.
+
+``send`` read-modify-writes ``self.seq`` WITHOUT the lock while
+``reset`` writes it under the lock — two concurrent sends mint the
+same seq and the second put silently overwrites the first message (a
+lost submit = a forever-hang breaking done-XOR-shed).
+"""
+
+import threading
+
+
+class Sender:
+    def __init__(self, store):
+        self.store = store
+        self.seq = 0
+        self._lock = threading.Lock()
+
+    def reset(self, start):
+        with self._lock:
+            self.seq = int(start)
+
+    def send(self, payload):
+        seq = self.seq
+        self.store[seq] = payload
+        self.seq = seq + 1     # unguarded-shared-write fires here
+        return seq

@@ -1,0 +1,22 @@
+"""CLEAN: seq-mint and the put serialize under one lock (the shipped
+MailboxSender shape after the PR 10 review fix)."""
+
+import threading
+
+
+class Sender:
+    def __init__(self, store):
+        self.store = store
+        self.seq = 0
+        self._lock = threading.Lock()
+
+    def reset(self, start):
+        with self._lock:
+            self.seq = int(start)
+
+    def send(self, payload):
+        with self._lock:
+            seq = self.seq
+            self.store[seq] = payload
+            self.seq = seq + 1
+        return seq

@@ -1,0 +1,21 @@
+"""BAD: sleeping and joining a thread while holding the state lock —
+the drain poll blocks every submit for the full wait (and if the
+joined thread needs the same lock to finish, the join never returns).
+"""
+
+import threading
+import time
+
+
+class Supervisor:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._thread = None
+        self.draining = False
+
+    def stop(self):
+        with self._lock:
+            self.draining = True
+            time.sleep(0.05)              # blocking-call-under-lock
+            if self._thread is not None:
+                self._thread.join(5)      # blocking-call-under-lock

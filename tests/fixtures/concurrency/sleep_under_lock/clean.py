@@ -1,0 +1,24 @@
+"""CLEAN: flip the flag under the lock, wait OUTSIDE it (the shipped
+stop()/shutdown() shape — string/path joins stay exempt too)."""
+
+import os
+import threading
+import time
+
+
+class Supervisor:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._thread = None
+        self.draining = False
+
+    def stop(self):
+        with self._lock:
+            self.draining = True
+            report = ", ".join(["drain", "requested"])
+            path = os.path.join("/tmp", "drain.marker")
+        thread = self._thread
+        if thread is not None:
+            thread.join(5)
+        time.sleep(0)
+        return report, path

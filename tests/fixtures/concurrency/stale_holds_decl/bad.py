@@ -1,0 +1,22 @@
+"""BAD: a STALE ``# holds-lock:`` declaration — the hook invocation
+was moved out of the critical section (correctly!) but the contract
+comment stayed behind, claiming a hold that no longer exists.  Like
+shardflow's stale-replication-annotation, a dead declaration is a lie
+the next reader trusts.
+"""
+
+import threading
+
+
+class Cache:
+    def __init__(self, on_evict=None):
+        self._lock = threading.Lock()
+        self.entries = {}
+        self.on_evict = on_evict
+
+    def evict(self, key):
+        with self._lock:
+            entry = self.entries.pop(key, None)
+        if entry is not None and self.on_evict is not None:
+            self.on_evict(entry)   # holds-lock: _lock
+        return entry

@@ -1,0 +1,19 @@
+"""CLEAN: the hook runs outside the lock and says nothing — no
+declaration needed when there is no hold (the spill-store shape:
+snapshot the victims under the lock, fire the hooks after)."""
+
+import threading
+
+
+class Cache:
+    def __init__(self, on_evict=None):
+        self._lock = threading.Lock()
+        self.entries = {}
+        self.on_evict = on_evict
+
+    def evict(self, key):
+        with self._lock:
+            entry = self.entries.pop(key, None)
+        if entry is not None and self.on_evict is not None:
+            self.on_evict(entry)
+        return entry
