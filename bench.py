@@ -698,6 +698,49 @@ def bench_serving():
         cm.pop("per_op", None)  # the tick's 2 ops don't warrant rows
         return cm
 
+    def journal_overhead():
+        """The causal journal's serving cost (ISSUE 17; the acceptance
+        bound is < 3% — cheap enough to leave on in production).
+
+        Differencing journal-on vs journal-off runs of THIS tiny bench
+        cannot resolve a 3% bound: adjacent identical runs vary ±40%
+        under CI load.  So the overhead is measured directly — the
+        journal-on run counts the events the serving path actually
+        emits, a microbench prices ONE emit (HLC stamp + JSON encode +
+        line-buffered write, the exact production code path, against
+        the same configured journal), and ``journal_overhead_frac`` is
+        journal-seconds over the run's own measured serving window
+        (tokens / tokens_per_sec).  Gates lower-is-better."""
+        import shutil
+        import tempfile
+        import time as _time
+
+        from chainermn_tpu.observability import journal as _journal
+
+        jdir = tempfile.mkdtemp(prefix="bench-journal-")
+        _journal.configure(jdir, "bench")
+        try:
+            on = run_point(1)
+            n_events = sum(len(_journal.read_journal(p))
+                           for p in _journal.find_journals(jdir))
+            reps = 5000
+            t0 = _time.perf_counter()
+            for i in range(reps):
+                _journal.emit("slot", op="bench", alloc=-1, slot=i % 4)
+            per_event_s = (_time.perf_counter() - t0) / reps
+        finally:
+            _journal.reset()
+            shutil.rmtree(jdir, ignore_errors=True)
+        tokens = max(n_requests - int(on["rejected"]), 1) * new
+        window_s = tokens / max(on["tokens_per_sec"], 1e-9)
+        return {
+            "tokens_per_sec_journal_on": on["tokens_per_sec"],
+            "journal_events": n_events,
+            "journal_event_cost_us": round(per_event_s * 1e6, 2),
+            "journal_overhead_frac": round(
+                (n_events * per_event_s) / window_s, 4),
+        }
+
     out = {
         "config": f"d{d_model} L{n_layers} h{n_heads} V{vocab} "
                   f"slots{n_slots} prompt{s_p} new{new} "
@@ -705,6 +748,11 @@ def bench_serving():
         "load_high": run_point(1),
         "load_low": run_point(4),
     }
+    try:
+        out["journal"] = journal_overhead()
+    except Exception as e:
+        print(f"bench: serving journal overhead failed: {e!r}",
+              file=sys.stderr)
     try:
         out["comm_per_tick"] = tick_comm_model()
     except Exception as e:
@@ -1179,8 +1227,13 @@ def bench_serving_chaos():
 
     Every-backend contract; ``detection``/``failover``/``shed``/
     ``recovery_s`` keys gate lower-is-better, ``drain_recovery_frac``
-    higher, in bench_history.jsonl.
+    higher, in bench_history.jsonl.  The whole run records an HLC
+    causal journal and replays it through the PR 15 protocol models
+    (ISSUE 17): ``conformance_violations`` gates lower-is-better — the
+    acceptance bound is 0.
     """
+    import shutil
+    import tempfile
     import threading
 
     import jax
@@ -1206,6 +1259,10 @@ def bench_serving_chaos():
                for _ in range(n_requests)]
     wk = dict(n_slots=4, max_total=s_p + new, queue_capacity=n_requests,
               mesh=mesh)
+
+    from chainermn_tpu.observability import journal as _journal
+    jdir = tempfile.mkdtemp(prefix="bench-chaos-journal-")
+    _journal.configure(jdir, "bench")
 
     router, runtimes = build_local_fleet(
         params, {"engine": 2}, head_dim=d_model // n_heads,
@@ -1306,7 +1363,29 @@ def bench_serving_chaos():
         t.join(timeout=5)
     router.close()
 
+    # replay the run's causal journal through the protocol models: the
+    # kill, the failover, and the drain must all conform (0 violations)
+    _journal.reset()
+    conformance = {"conformance_ok": None, "conformance_violations": None}
+    try:
+        from chainermn_tpu.observability.conform import (check_dir,
+                                                         render_report)
+        report = check_dir(jdir)
+        conformance = {
+            "conformance_ok": bool(report["ok"]),
+            "conformance_violations": len(report["violations"]),
+            "conformance_checked": report["checked"],
+        }
+        if not report["ok"]:
+            print(render_report(report), file=sys.stderr)
+    except Exception as e:
+        print(f"bench: chaos conformance replay failed: {e!r}",
+              file=sys.stderr)
+    finally:
+        shutil.rmtree(jdir, ignore_errors=True)
+
     return {
+        **conformance,
         "config": f"2 engine workers (+1 replacement), d{d_model} "
                   f"L{n_layers} V{vocab} prompt{s_p} new{new} "
                   f"x{n_requests}, beat 20ms × miss 4, loopback lanes",
@@ -1692,6 +1771,9 @@ def bench_train_chaos():
     N, VICTIM, KILL_AT, TOTAL, M = 4, 2, 9, 12, 24
     BEAT, MISS, CKPT_EVERY = 0.02, 3, 5
     tmp = tempfile.mkdtemp(prefix="bench-train-chaos-")
+    from chainermn_tpu.observability import journal as _journal
+    jdir = tempfile.mkdtemp(prefix="bench-train-journal-")
+    _journal.configure(jdir, "bench")
     try:
         store = FileLaneStore(tmp)
         gangs = [SelfHealingGang(store, rank=i, world=N, name="bench",
@@ -1793,9 +1875,22 @@ def bench_train_chaos():
         for i in range(N):
             if i != VICTIM:
                 gangs[i].stop()
+        # conformance verdict for the gang run (ISSUE 17): the victim's
+        # stale lease and the survivors' reconfig must replay cleanly
+        _journal.reset()
+        try:
+            from chainermn_tpu.observability.conform import check_dir
+            report = check_dir(jdir)
+            out["conformance_ok"] = bool(report["ok"])
+            out["conformance_violations"] = len(report["violations"])
+        except Exception as e:
+            print(f"bench: train chaos conformance replay failed: {e!r}",
+                  file=sys.stderr)
         return out
     finally:
+        _journal.reset()
         shutil.rmtree(tmp, ignore_errors=True)
+        shutil.rmtree(jdir, ignore_errors=True)
 
 
 def scaling_worker(n, grad_dtype=None, double_buffering=False):
@@ -2665,6 +2760,10 @@ def main():
                                     "detection_ms"),
             "chaos_drain_recovery": g(result, "serving_chaos",
                                       "drain_recovery_frac"),
+            "chaos_conformance_violations": g(result, "serving_chaos",
+                                              "conformance_violations"),
+            "serving_journal_overhead": g(result, "serving", "journal",
+                                          "journal_overhead_frac"),
             "autoscale_flap": g(result, "serving_autoscale", "flap"),
             "autoscale_gold_ttft_p99": g(result, "serving_autoscale",
                                          "gold_ttft_p99_ms"),

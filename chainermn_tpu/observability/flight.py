@@ -70,6 +70,7 @@ class FlightRecorder:
         self._ring: deque = deque(maxlen=self.capacity)
         self._lock = threading.Lock()
         self._seq = 0
+        self._dropped: Dict[str, int] = {}
         self.enabled = True
 
     def record(self, kind: str, **fields) -> None:
@@ -82,6 +83,12 @@ class FlightRecorder:
         with self._lock:
             self._seq += 1
             ev["seq"] = self._seq
+            if len(self._ring) == self.capacity:
+                # the deque is about to evict its head silently: count
+                # the loss PER EMITTER KIND so a postmortem knows whose
+                # evidence fell off (ISSUE 17 satellite)
+                evicted = self._ring[0].get("kind", "?")
+                self._dropped[evicted] = self._dropped.get(evicted, 0) + 1
             self._ring.append(ev)
 
     def events(self) -> List[Dict[str, Any]]:
@@ -93,10 +100,18 @@ class FlightRecorder:
         with self._lock:
             return self._seq
 
+    def dropped_counts(self) -> Dict[str, int]:
+        """Events dropped from the ring head, per kind — the
+        ``flight/dropped/*`` gauges and the bundle MANIFEST's loss
+        accounting."""
+        with self._lock:
+            return dict(self._dropped)
+
     def clear(self) -> None:
         with self._lock:
             self._ring.clear()
             self._seq = 0
+            self._dropped = {}
 
     def last(self, kind: Optional[str] = None) -> Optional[Dict[str, Any]]:
         """Most recent event (optionally of one ``kind``), or None."""
@@ -122,6 +137,18 @@ _CRASH_DUMP_DIR: Optional[str] = None
 _LAST_BUNDLE: Optional[str] = None
 _tee_installed = False
 
+#: When the causal journal is configured (observability.journal), every
+#: module-level note tees into it too — the journal registers itself
+#: here so this module stays ignorant of it (and note() stays one
+#: attribute load + None check when journaling is off).
+_JOURNAL_TEE: Optional[Callable[[str, Dict[str, Any]], None]] = None
+
+
+def set_journal_tee(fn: Optional[Callable[[str, Dict[str, Any]], None]]
+                    ) -> None:
+    global _JOURNAL_TEE
+    _JOURNAL_TEE = fn
+
 
 def get_flight_recorder() -> FlightRecorder:
     return _GLOBAL
@@ -130,6 +157,9 @@ def get_flight_recorder() -> FlightRecorder:
 def note(kind: str, **fields) -> None:
     """Module-level convenience over the global ring."""
     _GLOBAL.record(kind, **fields)
+    tee = _JOURNAL_TEE
+    if tee is not None:
+        tee(kind, fields)
 
 
 def register_provider(name: str, fn: Callable[[], Any]) -> None:
@@ -365,6 +395,7 @@ def dump_bundle(out_dir: str, reason: str, *,
             "ring_capacity": _GLOBAL.capacity,
             "ring_dropped_from_head": max(
                 _GLOBAL.total_seen - len(events), 0),
+            "ring_dropped_by_kind": _GLOBAL.dropped_counts(),
         }
         if extra:
             manifest["extra"] = extra

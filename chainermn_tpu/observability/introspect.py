@@ -93,6 +93,14 @@ class StatusServer:
                 extra = self.extra_gauges()
             except Exception:
                 extra = None
+        # flight-ring loss accounting rides every exposition: a dropped
+        # event is missing evidence, and /metricsz is where a scrape
+        # learns the ring overflowed (ISSUE 17 satellite)
+        dropped = _flight.get_flight_recorder().dropped_counts()
+        if dropped:
+            extra = dict(extra or {})
+            for kind, n in sorted(dropped.items()):
+                extra[f"flight/dropped/{kind}"] = float(n)
         return prometheus_text(extra)
 
     def requestz(self) -> Any:

@@ -641,6 +641,7 @@ def local_spawn_factory(params, router, *, head_dim: int,
 def proc_spawn_factory(lane_dir: str, params_file: str, *,
                        beat_interval_s: float = 0.05,
                        bundle_dir: Optional[str] = None,
+                       journal_dir: Optional[str] = None,
                        env: Optional[Dict[str, str]] = None):
     """``spawn(name, role)`` for cross-process fleets: execs a real
     worker process over the file lanes (the ``build_proc_fleet``
@@ -653,7 +654,8 @@ def proc_spawn_factory(lane_dir: str, params_file: str, *,
     def spawn(name: str, role: str):
         proc = spawn_worker(lane_dir, params_file, name, role, epoch=1,
                             beat_interval_s=beat_interval_s,
-                            bundle_dir=bundle_dir, env=env)
+                            bundle_dir=bundle_dir,
+                            journal_dir=journal_dir, env=env)
         return WorkerClient(name, role, store, epoch=1, proc=proc)
 
     return spawn

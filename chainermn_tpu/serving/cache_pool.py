@@ -62,8 +62,15 @@ ref pins a slot forever, silently shrinking the pool.
 
 from __future__ import annotations
 
+import itertools
 import threading
 from typing import Dict, List, Optional
+
+from ..observability import journal as _journal
+
+#: Distinguishes interleaved allocators in ONE process's journal (an
+#: in-process fleet runs several engines, each with its own pool).
+_ALLOC_IDS = itertools.count()
 
 
 class SlotAllocator:
@@ -89,6 +96,13 @@ class SlotAllocator:
         # thread — every state transition is a compound read-then-write,
         # so the lock is load-bearing, not defensive
         self._lock = threading.Lock()
+        # the conformance monitor replays these against the ISSUE 15
+        # slot_lifecycle model — op=init carries the universe size
+        self._aid = next(_ALLOC_IDS)
+        self._jemit("init", n_slots=self.n_slots)
+
+    def _jemit(self, op: str, **fields) -> None:
+        _journal.emit("slot", op=op, alloc=self._aid, **fields)
 
     def acquire(self) -> Optional[int]:
         """Lowest free slot index, or None when the pool is saturated."""
@@ -97,7 +111,8 @@ class SlotAllocator:
                 return None
             slot = self._free.pop(0)
             self._busy.add(slot)
-            return slot
+        self._jemit("acquire", slot=slot)
+        return slot
 
     def release(self, slot: int) -> None:
         with self._lock:
@@ -110,6 +125,7 @@ class SlotAllocator:
             # deterministic
             self._free.append(slot)
             self._free.sort()
+        self._jemit("release", slot=slot)
 
     # ---- transfer-destination reservations: free -> reserved -> busy ----
     def reserve(self) -> Optional[int]:
@@ -122,7 +138,8 @@ class SlotAllocator:
                 return None
             slot = self._free.pop(0)
             self._reserved.add(slot)
-            return slot
+        self._jemit("reserve", slot=slot)
+        return slot
 
     def commit_reservation(self, slot: int) -> None:
         """The slab landed: promote the reservation to a busy slot."""
@@ -134,6 +151,7 @@ class SlotAllocator:
                     f"reserved={sorted(self._reserved)}")
             self._reserved.remove(slot)
             self._busy.add(slot)
+        self._jemit("commit_reservation", slot=slot)
 
     def cancel_reservation(self, slot: int) -> None:
         """The transfer failed: return the held slot to the free list."""
@@ -146,6 +164,7 @@ class SlotAllocator:
             self._reserved.remove(slot)
             self._free.append(slot)
             self._free.sort()
+        self._jemit("cancel_reservation", slot=slot)
 
     # ---- prefix-cache faces: busy -> cached(rc) -> free ----
     def cache(self, slot: int) -> None:
@@ -157,6 +176,7 @@ class SlotAllocator:
                                  f"busy={sorted(self._busy)}")
             self._busy.remove(slot)
             self._cached[slot] = 0
+        self._jemit("cache", slot=slot)
 
     def retain(self, slot: int) -> int:
         """Pin a cached slot for one more in-flight reader."""
@@ -165,7 +185,9 @@ class SlotAllocator:
                 raise ValueError(f"slot {slot} is not cached; "
                                  f"cached={sorted(self._cached)}")
             self._cached[slot] += 1
-            return self._cached[slot]
+            rc = self._cached[slot]
+        self._jemit("retain", slot=slot)
+        return rc
 
     def unretain(self, slot: int) -> int:
         with self._lock:
@@ -176,7 +198,9 @@ class SlotAllocator:
                 raise ValueError(f"slot {slot} refcount underflow "
                                  f"(double unretain)")
             self._cached[slot] -= 1
-            return self._cached[slot]
+            rc = self._cached[slot]
+        self._jemit("unretain", slot=slot)
+        return rc
 
     def uncache(self, slot: int) -> None:
         """Evict a cached slot back to the free list (rc must be 0: an
@@ -192,6 +216,7 @@ class SlotAllocator:
             del self._cached[slot]
             self._free.append(slot)
             self._free.sort()
+        self._jemit("uncache", slot=slot)
 
     def refcount(self, slot: int) -> Optional[int]:
         return self._cached.get(slot)

@@ -495,6 +495,7 @@ class DisaggRouter(RouterBase):
                                   if deadline_s is not None else None),
                       on_token=on_token, trace_id=trace_id,
                       temperature=temperature, rng=key, tenant=tenant)
+        self._stamp_tenant_meta(req, tenant)
         req.trace_us = {"submitted": obs.now_us()}
         obs.async_event("b", "request", trace_id, cat="serving_request",
                         request=req.id, prompt_len=req.prompt_len)

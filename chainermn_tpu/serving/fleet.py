@@ -57,6 +57,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 from .. import observability as obs
 from ..communicators.base import DcnLaneError
 from ..observability import flight as _flight
+from ..observability import journal as _journal
 from ..observability.slo import (GoodputLedger, ReservoirSample,
                                  SLOTracker, percentile_of)
 from .fleet_cache import FleetCacheIndex
@@ -370,6 +371,7 @@ class FleetRouter(RouterBase):
                       temperature=temperature, rng=key, tenant=tenant)
         req.status = "running"   # mirror: the worker owns queueing
         req.timestamps["submitted"] = now
+        self._stamp_tenant_meta(req, tenant)
         entry = {"req": req, "worker": wc.name, "attempts": 1}
         # fleet KV economy (ISSUE 12): a local miss with a remote hit
         # may be worth PULLING the prefix slab instead of re-prefilling
@@ -395,6 +397,11 @@ class FleetRouter(RouterBase):
                 "worker_lost", trace_id,
                 f"fleet router thread died: {dead}",
                 retry_after_ms=1.0, queue_depth=0, tenant=tenant)
+        # the registration event anchors the request's causal story
+        # (ISSUE 17): every accepted entry journals exactly one
+        # "submitted" before any dispatch/pull/failover touches it
+        _flight.note("fleet", event="submitted", trace_id=trace_id,
+                     worker=wc.name, tenant=tenant)
         if pull is not None:
             # the pull path holds the submit back until the prefix
             # lands (or the pull degrades): the owner packs the slab,
@@ -1022,8 +1029,17 @@ class FleetRouter(RouterBase):
             if lease is not None \
                     and int(lease.get("seq", -1)) != wc.judged_seq:
                 wc.judged_seq = int(lease.get("seq", -1))
-                if self.fence.admit(wc.name, lease.get("epoch", -1),
-                                    "lease"):
+                admitted = self.fence.admit(
+                    wc.name, lease.get("epoch", -1), "lease")
+                # merge the beat's HLC: the publisher's write
+                # happens-before this judgment in the fleet timeline,
+                # and the admitted flag is what conformance replays
+                # against the lease_fence model (ISSUE 17)
+                _journal.recv_emit(
+                    lease.get("hlc"), "lease_judged", worker=wc.name,
+                    epoch=lease.get("epoch"), lseq=wc.judged_seq,
+                    admitted=admitted)
+                if admitted:
                     with self._lock:   # resets sent_since_lease, which
                         # submit threads increment under the same lock
                         wc.observe_lease(lease)
@@ -1139,12 +1155,6 @@ class FleetRouter(RouterBase):
         self.cache_index.drop_worker(wc.name)
         self._cancel_pulls_on(wc.name, f"died ({why})")
         lane = f"worker_lane/{out_mailbox(wc.name)}/recv"
-        outcomes = []
-        with self._lock:
-            owned = [e for e in self._inflight.values()
-                     if e["worker"] == wc.name]
-        for entry in owned:
-            outcomes.append(self._failover(entry, why))
         detection = {
             "worker": wc.name,
             "role": wc.role,
@@ -1153,11 +1163,21 @@ class FleetRouter(RouterBase):
             "lease_age_s": round(age, 4),
             "detection_window_s": round(self.lease_window_s, 4),
             "epoch_fenced": self.fence.current(wc.name),
-            "in_flight": outcomes,
         }
+        # the detection note goes down BEFORE the failover sweep: the
+        # causal journal must show worker_lost happens-before every
+        # redispatched/shed it triggers, or the conformance replay
+        # (observability/conform.py) sees a failover of a worker the
+        # router never declared dead
+        _flight.note("fleet", event="worker_lost", **detection)
+        outcomes = []
+        with self._lock:
+            owned = [e for e in self._inflight.values()
+                     if e["worker"] == wc.name]
+        for entry in owned:
+            outcomes.append(self._failover(entry, why))
+        detection["in_flight"] = outcomes
         self.last_detection = detection
-        _flight.note("fleet", event="worker_lost", **{
-            k: v for k, v in detection.items() if k != "in_flight"})
         if self.bundle_dir:
             _flight.dump_bundle(self.bundle_dir, "worker_lost",
                                 extra={"worker_lost": detection})
@@ -1627,6 +1647,7 @@ def write_params_file(path: str, params, *, head_dim: int,
 def spawn_worker(lane_dir: str, params_file: str, name: str, role: str,
                  *, epoch: int = 1, beat_interval_s: float = 0.05,
                  bundle_dir: Optional[str] = None,
+                 journal_dir: Optional[str] = None,
                  env: Optional[Dict[str, str]] = None,
                  stdout=None) -> subprocess.Popen:
     """Exec one worker process (detached role loop over the file
@@ -1637,6 +1658,8 @@ def spawn_worker(lane_dir: str, params_file: str, name: str, role: str,
            "--beat-interval-s", str(beat_interval_s)]
     if bundle_dir:
         cmd += ["--bundle-dir", bundle_dir]
+    if journal_dir:
+        cmd += ["--journal-dir", journal_dir]
     penv = dict(os.environ)
     penv.setdefault("JAX_PLATFORMS", "cpu")
     if env:
@@ -1654,6 +1677,7 @@ def build_proc_fleet(params, topology: Dict[str, int], lane_dir: str, *,
                      head_dim: int, beat_interval_s: float = 0.05,
                      miss_beats: int = 4,
                      bundle_dir: Optional[str] = None,
+                     journal_dir: Optional[str] = None,
                      worker_kwargs: Optional[Dict[str, Any]] = None,
                      env: Optional[Dict[str, str]] = None,
                      **router_kwargs) -> FleetRouter:
@@ -1661,10 +1685,15 @@ def build_proc_fleet(params, topology: Dict[str, int], lane_dir: str, *,
     count (``{"engine": N}`` for ``serve --fleet-procs N``,
     ``{"prefill": P, "decode": D}`` for ``--disagg P:D --procs``).
     The caller drives :meth:`FleetRouter.step` (or ``start()``) and
-    finishes with :meth:`FleetRouter.shutdown`."""
+    finishes with :meth:`FleetRouter.shutdown`.  ``journal_dir`` turns
+    on the causal HLC journal (ISSUE 17) in the router process AND
+    every spawned worker — merge with
+    :func:`~chainermn_tpu.observability.journal.merge_journals`."""
     from .lanes import FileLaneStore
 
     os.makedirs(lane_dir, exist_ok=True)
+    if journal_dir:
+        _journal.configure(journal_dir, "router")
     params_file = write_params_file(
         os.path.join(lane_dir, "fleet_params.pkl"), params,
         head_dim=head_dim, **(worker_kwargs or {}))
@@ -1675,7 +1704,8 @@ def build_proc_fleet(params, topology: Dict[str, int], lane_dir: str, *,
             name = f"{role}{i}"
             proc = spawn_worker(lane_dir, params_file, name, role,
                                 epoch=1, beat_interval_s=beat_interval_s,
-                                bundle_dir=bundle_dir, env=env)
+                                bundle_dir=bundle_dir,
+                                journal_dir=journal_dir, env=env)
             clients.append(WorkerClient(name, role, store, epoch=1,
                                         proc=proc))
     return FleetRouter(clients, store,

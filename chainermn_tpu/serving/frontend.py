@@ -138,9 +138,13 @@ def _request_row(req: Request) -> Dict[str, Any]:
         "max_new_tokens": req.max_new_tokens,
         "n_tokens": len(req.tokens),
         "timestamps": {k: round(v, 6) for k, v in ts.items()},
+        # tenancy columns (ISSUE 11 plane, ISSUE 17 satellite): always
+        # present so the table schema is stable — None means the
+        # request never crossed a tenant-aware router
+        "tenant": req.tenant,
+        "priority": getattr(req, "priority", None),
+        "rung": getattr(req, "rung", None),
     }
-    if req.tenant is not None:
-        row["tenant"] = req.tenant
     if "submitted" in ts and "first_token" in ts:
         row["ttft_ms"] = round(
             (ts["first_token"] - ts["submitted"]) * 1e3, 3)

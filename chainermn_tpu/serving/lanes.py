@@ -171,6 +171,22 @@ def lane_try_get(store, lane: str, tag: str,
     return lane_call(lane, _try, config)
 
 
+def _msg_journal_fields(msg: Dict[str, Any]) -> Dict[str, Any]:
+    """The identity fields a journaled mailbox event carries: enough to
+    thread one request's causal story across processes (trace id, the
+    worker and epoch the protocol stamps) without copying payloads."""
+    out: Dict[str, Any] = {}
+    trace_id = msg.get("trace_id")
+    if trace_id is None and isinstance(msg.get("req"), dict):
+        trace_id = msg["req"].get("trace_id")
+    if trace_id is not None:
+        out["trace_id"] = trace_id
+    for k in ("worker", "epoch"):
+        if msg.get(k) is not None:
+            out[k] = msg[k]
+    return out
+
+
 class MailboxSender:
     """The single writer of one named mailbox (ordered, at-most-once).
 
@@ -199,12 +215,22 @@ class MailboxSender:
         """Publish one message; returns its sequence number.
         Thread-safe: concurrent sends serialize and get distinct seqs."""
         from ..communicators.base import lane_call
+        from ..observability import journal as _journal
 
         with self._lock:
             seq = self.seq
-            payload = pickle.dumps(
-                dict(msg, schema=MSG_SCHEMA, seq=seq),
-                protocol=pickle.HIGHEST_PROTOCOL)
+            wire = dict(msg, schema=MSG_SCHEMA, seq=seq)
+            if _journal.enabled():
+                # the HLC rides as ONE extra field in the worker_lane.v1
+                # dict (ISSUE 17): the stamp is the journaled send
+                # event's own, so the receiver's merge orders the
+                # receive strictly after this line in the fleet timeline
+                wire["hlc"] = _journal.wire_emit(
+                    "mbx_send", mailbox=self.name, mseq=seq,
+                    msg_kind=msg.get("kind"),
+                    **_msg_journal_fields(msg))
+            payload = pickle.dumps(wire,
+                                   protocol=pickle.HIGHEST_PROTOCOL)
             tag = f"mbx/{self.name}/{seq}"
             lane_call(f"worker_lane/{self.name}/send",
                       lambda: self.store.put(tag, payload), self.config)
@@ -236,6 +262,14 @@ class MailboxReceiver:
                 f"refusing worker-lane message with schema "
                 f"{msg.get('schema')!r} on mailbox {self.name!r} "
                 f"(this receiver speaks {MSG_SCHEMA})")
+        from ..observability import journal as _journal
+        if _journal.enabled():
+            # merge the sender's HLC so cross-process causality is
+            # captured on the existing wire (send happens-before recv)
+            _journal.recv_emit(
+                msg.get("hlc"), "mbx_recv", mailbox=self.name,
+                mseq=self.next_seq, msg_kind=msg.get("kind"),
+                **_msg_journal_fields(msg))
         from ..communicators.base import lane_call
         lane_call(f"worker_lane/{self.name}/gc",
                   lambda: self.store.delete(tag), self.config)

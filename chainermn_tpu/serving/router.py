@@ -264,6 +264,18 @@ class RouterBase:
             capped = tab.ladder.cap_max_tokens(capped)
         return t.name, capped, capped < int(max_new_tokens)
 
+    def _stamp_tenant_meta(self, req, tenant: Optional[str]) -> None:
+        """Stamp the admitted request with its resolved priority and
+        the degradation rung it was admitted under — the stable
+        /requestz tenancy columns (``_request_row`` always emits
+        ``tenant``/``priority``/``rung``; None means the request never
+        crossed a tenant-aware router)."""
+        if self.tenancy is None:
+            return
+        req.rung = self.tenancy.ladder.rung
+        if tenant is not None:
+            req.priority = self.tenancy.resolve(tenant).priority
+
 
 class ServingRouter(RouterBase):
     """Process-level router fronting N :class:`Replica` engines.
@@ -391,6 +403,7 @@ class ServingRouter(RouterBase):
             self._reject(e.reason, trace_id, str(e),
                          retry_after_ms=self._retry_after_ms(loads),
                          queue_depth=fleet_depth, tenant=tenant)
+        self._stamp_tenant_meta(handle._req, tenant)
         if self.tenancy is not None and tenant is not None:
             self.tenancy.on_admit(self.tenancy.resolve(tenant),
                                   handle._req, capped=capped)

@@ -47,6 +47,7 @@ from typing import Any, Dict, Optional
 import numpy as np
 
 from ..observability import flight as _flight
+from ..observability import journal as _journal
 from .health import HeartbeatPublisher
 from .lanes import MailboxReceiver, MailboxSender
 from .scheduler import AdmissionError, Request, Scheduler
@@ -263,6 +264,11 @@ class WorkerRuntime:
             # so the fence re-opens for exactly this incarnation
             self.epoch = int(msg["epoch"])
             self.heart.epoch = self.epoch
+            # the hello's HLC was already merged at mbx_recv; this event
+            # marks the instant the new epoch takes effect worker-side —
+            # the conformance monitor's worker.process_hello action
+            _journal.emit("hello_processed", worker=self.name,
+                          epoch=self.epoch)
             self.heart.beat(**self._lease_state())
             # full cache-index rebuild rides the handshake (ISSUE 12):
             # the router dropped every fenced-epoch entry at death,
@@ -754,7 +760,15 @@ def main(argv=None) -> int:
     parser.add_argument("--epoch", type=int, default=1)
     parser.add_argument("--beat-interval-s", type=float, default=0.05)
     parser.add_argument("--bundle-dir", default=None)
+    parser.add_argument("--journal-dir", default=None,
+                        help="causal HLC journal directory (ISSUE 17); "
+                             "this worker tees its state transitions "
+                             "into journal.<name>.jsonl there")
     args = parser.parse_args(argv)
+
+    if args.journal_dir:
+        from ..observability import journal
+        journal.configure(args.journal_dir, args.name)
 
     import jax  # noqa: F401 — ensure backend init before engine build
 

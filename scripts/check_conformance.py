@@ -1,0 +1,84 @@
+#!/usr/bin/env python
+"""Conformance gate: replay a causal journal through the protocol
+models, exit nonzero on a violation.
+
+The runtime half of the PR 15 model checker: ``analysis/protocol.py``
+proves the done-XOR-shed / lease-fence / slot-lifecycle protocols over
+every interleaving of a bounded model; this gate replays what a REAL
+run actually did (the HLC journal a fleet writes under ``--journal``,
+one ``journal.<proc>.jsonl`` per process) through those same models
+(``observability/conform.py``) and renders any violation as a minimal
+causal chain with the offending happens-before edge named.
+
+CI wiring: the chaos suites record journals and assert this gate's
+verdict; ``pytest -m lint`` runs it over a synthetic fleet journal
+(tests/test_journal.py), so the replay machinery itself is gated.
+
+No JAX import: the gate runs on any box that can read JSON.
+
+Exit codes: 0 = conformant, 1 = violation(s) found, 2 = inputs
+unusable (no journal files, unreadable directory, bad arguments).
+
+Usage::
+
+    python scripts/check_conformance.py /path/to/journal_dir
+    python scripts/check_conformance.py journal_dir --json
+    python scripts/check_conformance.py journal_dir --merged-out m.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="check_conformance.py",
+        description="Replay a fleet's HLC journal through the protocol "
+                    "models (docs/OBSERVABILITY.md)")
+    p.add_argument("journal_dir",
+                   help="directory holding journal.<proc>.jsonl files")
+    p.add_argument("--json", action="store_true",
+                   help="emit the conformance report as JSON")
+    p.add_argument("--merged-out", default=None,
+                   help="also write the merged timeline document here")
+    args = p.parse_args(argv)
+
+    from chainermn_tpu.observability.conform import (check_conformance,
+                                                     render_report)
+    from chainermn_tpu.observability.journal import (find_journals,
+                                                     merge_journals)
+
+    if not os.path.isdir(args.journal_dir):
+        print(f"error: {args.journal_dir!r} is not a directory",
+              file=sys.stderr)
+        return 2
+    if not find_journals(args.journal_dir):
+        print(f"error: no journal.*.jsonl files in "
+              f"{args.journal_dir!r} (nothing to check)",
+              file=sys.stderr)
+        return 2
+    try:
+        merged = merge_journals(args.journal_dir,
+                                out_path=args.merged_out)
+    except (OSError, ValueError) as e:
+        print(f"error: cannot merge journals: {e}", file=sys.stderr)
+        return 2
+
+    report = check_conformance(merged)
+    if args.json:
+        print(json.dumps(report, indent=2, sort_keys=True, default=str))
+    else:
+        print(render_report(report))
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

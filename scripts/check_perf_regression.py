@@ -97,12 +97,16 @@ _SKIP = re.compile(
 #: good), while quant_wire_bytes / quant_predicted_bytes / scale_bytes
 #: match `bytes` and ef_loss_gap matches `gap`+`loss` — wire traffic
 #: and the EF-vs-fp32 training gap gate lower-is-better).
+#: journal_overhead_frac / conformance_violations match
+#: `overhead`/`violation` — the causal journal's serving cost and
+#: protocol-replay divergence both gate lower-is-better.
 _LOWER = re.compile(
     r"(time|_ms|ms_|/ms$|^ms$|latency|seconds|_s$|/s$|bytes|loss|"
     r"step_ms|gap|slowdown|imbalance|drift|anomal|dropped|findings|"
     r"rejected|shed|steps_to_recover|variance|requeue|detection|"
     r"failover|fenced|redispatch|flap|ttft|rung|degraded|"
-    r"prefill_calls|stale|spill|crc|reconfig|consensus|steps_lost)",
+    r"prefill_calls|stale|spill|crc|reconfig|consensus|steps_lost|"
+    r"overhead|violation)",
     re.IGNORECASE)
 
 

@@ -554,6 +554,46 @@ def render_text(rep: dict) -> str:
     return "\n".join(lines)
 
 
+def explain_request(path: str, trace_id: str, *,
+                    as_json: bool = False) -> int:
+    """The ``--request`` face: the full causal story of one request —
+    submit → dispatch → [pull] → prefill → ticks → done/shed, with any
+    failover hop — from a merged HLC journal (ISSUE 17)."""
+    from chainermn_tpu.observability.journal import (
+        MERGE_SCHEMA, find_journals, merge_journals, render_request_story,
+        request_story)
+
+    if os.path.isdir(path):
+        if not find_journals(path):
+            print(f"explain_bundle: no journal.*.jsonl files under "
+                  f"{path!r}", file=sys.stderr)
+            return 2
+        merged = merge_journals(path)
+    else:
+        try:
+            with open(path) as f:
+                merged = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"explain_bundle: cannot read merged journal "
+                  f"{path!r}: {e}", file=sys.stderr)
+            return 2
+        if merged.get("schema") != MERGE_SCHEMA:
+            print(f"explain_bundle: {path!r} has schema "
+                  f"{merged.get('schema')!r}, expected {MERGE_SCHEMA}",
+                  file=sys.stderr)
+            return 2
+    story = request_story(merged, trace_id)
+    if not story["events"]:
+        print(f"explain_bundle: no journaled events for request "
+              f"{trace_id!r}", file=sys.stderr)
+        return 2
+    if as_json:
+        print(json.dumps(story, indent=2, sort_keys=True, default=str))
+    else:
+        print(render_request_story(story))
+    return 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         description="render a chainermn_tpu debug bundle into a "
@@ -566,7 +606,16 @@ def main(argv=None) -> int:
                              "rank of a gang), render every one")
     parser.add_argument("--json", action="store_true",
                         help="machine-readable output")
+    parser.add_argument("--request", default=None, metavar="TRACE_ID",
+                        help="render ONE request's cross-process causal "
+                             "story from a merged HLC journal; PATH is "
+                             "then a journal directory (journal.*.jsonl "
+                             "files) or a merged journal JSON")
     args = parser.parse_args(argv)
+
+    if args.request is not None:
+        return explain_request(args.path, args.request,
+                               as_json=args.json)
 
     if os.path.exists(os.path.join(args.path, "MANIFEST.json")):
         paths = [args.path]

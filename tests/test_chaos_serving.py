@@ -77,10 +77,11 @@ def test_sigkill_zombie_and_drain_against_real_processes(devices,
         jax.random.PRNGKey(0), VOCAB, D, HEADS, LAYERS, max_len=64,
         pos_impl="rope")
     bundles = str(tmp_path / "bundles")
+    journal_dir = str(tmp_path / "journal")
     router = build_proc_fleet(
         params, {"engine": 3}, str(tmp_path / "lanes"),
         head_dim=HEAD_DIM, beat_interval_s=0.05, miss_beats=4,
-        bundle_dir=bundles, env=_worker_env(),
+        bundle_dir=bundles, journal_dir=journal_dir, env=_worker_env(),
         worker_kwargs=dict(n_slots=2, max_total=24, queue_capacity=16))
     oracle = _oracle_fn(params, devices, 8)
     try:
@@ -112,8 +113,11 @@ def test_sigkill_zombie_and_drain_against_real_processes(devices,
         det = router.last_detection
         assert det is not None and det["worker"] == "engine0"
         assert "out.engine0" in det["lane"]
-        # detection within the window (+ generous pump-loop slack)
-        assert detect_s < router.lease_window_s + 2.0, detect_s
+        # detection within the window — detect_s is measured at the
+        # END of failover (kill -> every handle terminal), so the slack
+        # must absorb the survivors' re-decode of the whole batch under
+        # CI load, not just the supervisor poll cadence
+        assert detect_s < router.lease_window_s + 10.0, detect_s
         done = shed = 0
         for p, h in zip(prompts, handles):
             if h.status == "done":
@@ -194,10 +198,45 @@ def test_sigkill_zombie_and_drain_against_real_processes(devices,
     finally:
         codes = router.shutdown(timeout_s=60)
         router.close()
+        from chainermn_tpu.observability import journal as _journal
+        _journal.reset()
     # every surviving member terminated (no gang member hangs)
     for name, wc in router.workers.items():
         if wc.proc is not None:
             assert wc.proc.poll() is not None, f"{name} still running"
+
+    # ---- the causal journal of the WHOLE run replays cleanly through
+    # the protocol models (ISSUE 17): SIGKILL failover, fenced-zombie
+    # refusals, breaker readmission, and the drain — zero violations
+    from chainermn_tpu.observability.conform import (check_dir,
+                                                     render_report)
+    report = check_dir(journal_dir)
+    assert report["ok"], render_report(report)
+    assert report["checked"]["done_xor_shed"] >= len(prompts)
+    assert report["checked"]["lease_fence"] >= 3
+
+    # ---- one failed-over request's cross-process causal story:
+    # submit -> dispatch -> worker receive -> failover hop -> terminal,
+    # rendered by `explain_bundle.py --request <trace_id>`
+    from chainermn_tpu.observability.journal import merge_journals
+    merged = merge_journals(journal_dir)
+    redis = [e for e in merged["events"]
+             if e.get("kind") == "fleet"
+             and e.get("event") == "redispatched"]
+    assert redis, "SIGKILL under load must force at least one failover"
+    tid = redis[0]["trace_id"]
+    out = subprocess.run(
+        [sys.executable,
+         os.path.join(ROOT, "scripts", "explain_bundle.py"),
+         journal_dir, "--request", tid],
+        capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stderr
+    story = out.stdout
+    assert tid in story and "failover hop" in story
+    assert "event=submitted" in story and "event=redispatched" in story
+    assert "mbx_recv" in story         # the worker-side receive
+    assert "happens-after" in story    # cross-process edges called out
+    assert "outcome:" in story
 
 
 @pytest.mark.slow
@@ -220,16 +259,19 @@ def test_autoscale_real_process_scale_down_is_drain(devices, tmp_path):
         jax.random.PRNGKey(0), VOCAB, D, HEADS, LAYERS, max_len=64,
         pos_impl="rope")
     lane_dir = str(tmp_path / "lanes")
+    journal_dir = str(tmp_path / "journal")
     router = build_proc_fleet(
         params, {"engine": 1}, lane_dir,
         head_dim=HEAD_DIM, beat_interval_s=0.05, miss_beats=4,
-        bundle_dir=str(tmp_path / "bundles"), env=_worker_env(),
+        bundle_dir=str(tmp_path / "bundles"), journal_dir=journal_dir,
+        env=_worker_env(),
         worker_kwargs=dict(n_slots=2, max_total=24, queue_capacity=16))
     autoscaler = FleetAutoscaler(
         router,
         proc_spawn_factory(
             lane_dir, os.path.join(lane_dir, "fleet_params.pkl"),
-            beat_interval_s=0.05, env=_worker_env()),
+            beat_interval_s=0.05, journal_dir=journal_dir,
+            env=_worker_env()),
         policies=[AutoscalePolicy(
             role="engine", min_workers=1, max_workers=2,
             up_backlog_tokens_per_worker=24.0,
@@ -287,9 +329,17 @@ def test_autoscale_real_process_scale_down_is_drain(devices, tmp_path):
     finally:
         router.shutdown(timeout_s=60)
         router.close()
+        from chainermn_tpu.observability import journal as _journal
+        _journal.reset()
     for name, wc in router.workers.items():
         if wc.proc is not None:
             assert wc.proc.poll() is not None, f"{name} still running"
+    # scale-up spawn, burst, and drain-down all conform (ISSUE 17)
+    from chainermn_tpu.observability.conform import (check_dir,
+                                                     render_report)
+    report = check_dir(journal_dir)
+    assert report["ok"], render_report(report)
+    assert report["checked"]["done_xor_shed"] >= len(handles)
 
 
 @pytest.mark.slow
@@ -322,6 +372,11 @@ def test_serving_chaos_bench_section_and_gate(tmp_path):
     assert section["drain_completed"] is True
     assert section["drain_shed"] == 0, section
     assert section["drain_recovery_frac"] >= 0.9, section
+    # the section's own causal journal replayed through the protocol
+    # models with ZERO violations (ISSUE 17)
+    assert section["conformance_ok"] is True, section
+    assert section["conformance_violations"] == 0, section
+    assert section["conformance_checked"]["done_xor_shed"] > 0, section
 
     path = tmp_path / "chaos.json"
     path.write_text(json.dumps({"serving_chaos": section}))
@@ -347,7 +402,9 @@ def test_serving_chaos_bench_section_and_gate(tmp_path):
                 "serving_chaos/kill_recovery_s",
                 "serving_chaos/drain_shed",
                 "serving_chaos/fenced_refusals",
-                "serving_chaos/redispatched"):
+                "serving_chaos/redispatched",
+                "serving_chaos/conformance_violations",
+                "serving/journal/journal_overhead_frac"):
         assert lower_is_better(key), key
     assert not lower_is_better("serving_chaos/drain_recovery_frac")
     assert not lower_is_better("serving_chaos/steady_tokens_per_sec")
@@ -396,10 +453,11 @@ def test_sigkill_slab_owner_mid_remote_pull_real_processes(devices,
         jax.random.PRNGKey(0), VOCAB, D, HEADS, LAYERS, max_len=64,
         pos_impl="rope")
     bundles = str(tmp_path / "bundles")
+    journal_dir = str(tmp_path / "journal")
     router = build_proc_fleet(
         params, {"engine": 2}, str(tmp_path / "lanes"),
         head_dim=HEAD_DIM, beat_interval_s=0.1, miss_beats=3,
-        bundle_dir=bundles, env=_worker_env(),
+        bundle_dir=bundles, journal_dir=journal_dir, env=_worker_env(),
         worker_kwargs=dict(n_slots=3, max_total=24, queue_capacity=16))
     oracle = _oracle_fn(params, devices, 6)
     try:
@@ -453,6 +511,16 @@ def test_sigkill_slab_owner_mid_remote_pull_real_processes(devices,
     finally:
         codes = router.shutdown()
         router.close()
+        from chainermn_tpu.observability import journal as _journal
+        _journal.reset()
     # the survivor exits cleanly; the SIGKILL'd owner reports -9
     assert codes.get(owner) == -signal.SIGKILL
     assert all(c == 0 for w, c in codes.items() if w != owner), codes
+    # mid-pull owner death conforms end to end (ISSUE 17): the pull
+    # cancellation, the counted fallback, and the slot churn all replay
+    # through the protocol models with zero violations
+    from chainermn_tpu.observability.conform import (check_dir,
+                                                     render_report)
+    report = check_dir(journal_dir)
+    assert report["ok"], render_report(report)
+    assert report["checked"]["slot_lifecycle"] >= 1

@@ -559,6 +559,11 @@ def test_statusz_live_subprocess(tmp_path):
         for row in table["recent"]:
             assert row["trace_id"].startswith("req-")
             assert row["status"] == "done"
+            # tenancy columns (ISSUE 17 satellite): ALWAYS present —
+            # None for requests that never crossed a tenant-aware
+            # router, so the table schema is stable
+            for col in ("tenant", "priority", "rung"):
+                assert col in row, (col, row)
 
         code, body = _get(base + "/debugz?dump=1")
         bundle = json.loads(body)["bundle"]

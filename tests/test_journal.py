@@ -1,0 +1,427 @@
+"""Causal fleet journal + runtime protocol conformance (ISSUE 17).
+
+Covers the HLC clock laws (local ticks and receive-merges strictly
+increase; a receive orders after its send), the bounded journal file's
+compaction contract, the merge property under fuzzed delayed/
+duplicated/reordered delivery (the merged timeline is a total order
+consistent with every per-process order AND every send→receive edge),
+the conformance monitor on clean and violating journals, the
+mutation-injection acceptance path (an un-fenced zombie write via
+``Model.replace`` is caught with a minimal causal chain naming the
+offending HLC edge), the ``check_conformance.py`` CLI's 0/1/2 exit
+contract, the flight-ring per-kind drop counters (satellite: /metricsz
+gauges + bundle MANIFEST), and the /requestz tenancy columns.
+"""
+
+import json
+import os
+import random
+import subprocess
+import sys
+
+import pytest
+
+from chainermn_tpu.analysis import protocol
+from chainermn_tpu.observability import flight as _flight
+from chainermn_tpu.observability import journal as jr
+from chainermn_tpu.observability.conform import (check_conformance,
+                                                 check_dir, render_report)
+from chainermn_tpu.observability.introspect import StatusServer
+from chainermn_tpu.serving.frontend import _request_row
+from chainermn_tpu.serving.scheduler import Request
+
+ROOT = os.path.join(os.path.dirname(__file__), "..")
+CLI = os.path.join(ROOT, "scripts", "check_conformance.py")
+
+
+@pytest.fixture(autouse=True)
+def _journal_off():
+    """Every test starts and ends with the global journal disabled."""
+    jr.reset()
+    yield
+    jr.reset()
+
+
+# ---------------------------------------------------------------------------
+# HLC laws
+# ---------------------------------------------------------------------------
+
+def test_hlc_ticks_strictly_increase_under_frozen_clock():
+    h = jr.HLC(now_us=lambda: 1000)
+    stamps = [h.tick() for _ in range(10)]
+    assert stamps[0] == (1000, 0)
+    assert all(a < b for a, b in zip(stamps, stamps[1:]))
+
+
+def test_hlc_merge_orders_receive_after_send():
+    # the receiver's wall clock is BEHIND the sender's: physical time
+    # alone would order the receive before the send — the merge must
+    # not
+    sender = jr.HLC(now_us=lambda: 5000)
+    receiver = jr.HLC(now_us=lambda: 10)
+    wire = sender.tick()
+    recv = receiver.merge(wire)
+    assert recv > wire
+    # and further local receiver ticks keep increasing past it
+    assert receiver.tick() > recv
+    # merge(None) degrades to a plain tick
+    assert receiver.merge(None) > recv
+
+
+def test_hlc_merge_monotone_both_faces():
+    t = [0]
+
+    def clock():
+        return t[0]
+
+    h = jr.HLC(now_us=clock)
+    last = h.tick()
+    rng = random.Random(7)
+    for _ in range(200):
+        t[0] += rng.choice([0, 0, 1, 50])
+        if rng.random() < 0.5:
+            cur = h.tick()
+        else:
+            cur = h.merge((rng.randrange(2000), rng.randrange(4)))
+        assert cur > last, (cur, last)
+        last = cur
+
+
+# ---------------------------------------------------------------------------
+# journal file: bounded, line-buffered, torn-tail tolerant
+# ---------------------------------------------------------------------------
+
+def test_journal_file_stays_bounded(tmp_path):
+    path = str(tmp_path / "journal.w0.jsonl")
+    j = jr.Journal(path, "w0", capacity=40)
+    for i in range(300):
+        j.emit("slot", op="acquire", slot=i % 4, alloc=0)
+    j.close()
+    evs = jr.read_journal(path)
+    assert len(evs) <= 2 * 40
+    assert j.dropped > 0
+    # the NEWEST events are the retained ones, still in seq order
+    seqs = [e["seq"] for e in evs]
+    assert seqs == sorted(seqs) and seqs[-1] == 300
+
+
+def test_read_journal_skips_torn_tail_refuses_foreign_schema(tmp_path):
+    path = str(tmp_path / "journal.w0.jsonl")
+    j = jr.Journal(path, "w0")
+    j.emit("beat", worker="w0")
+    j.close()
+    with open(path, "a") as f:
+        f.write('{"schema": "chainermn_tpu.journal.v1", "proc": "w0", '
+                '"kind": "beat", "hlc": [1,')   # killed mid-write
+    assert len(jr.read_journal(path)) == 1
+    with open(path, "a") as f:
+        f.write('\n{"schema": "someone.else.v9", "kind": "x"}\n')
+    with pytest.raises(ValueError):
+        jr.read_journal(path)
+
+
+# ---------------------------------------------------------------------------
+# merge property: total order consistent with per-proc orders and
+# send→receive edges, under fuzzed delayed/duplicated/reordered delivery
+# ---------------------------------------------------------------------------
+
+def test_merge_total_order_fuzz(tmp_path):
+    rng = random.Random(0x17C)
+    procs = ["router", "w0", "w1", "w2"]
+    # skewed, sometimes-frozen per-process clocks: the logical
+    # component has to do real work
+    clocks = {p: [rng.randrange(0, 5000)] for p in procs}
+    js = {p: jr.Journal(str(tmp_path / f"journal.{p}.jsonl"), p,
+                        capacity=10_000)
+          for p in procs}
+    for p in procs:
+        js[p].hlc = jr.HLC(now_us=lambda p=p: clocks[p][0])
+    in_flight = []      # (dst, mailbox, mseq, wire_stamp)
+    mseq = {p: 0 for p in procs}
+    n_events = 0
+    for _ in range(600):
+        src = rng.choice(procs)
+        if rng.random() < 0.4:
+            clocks[src][0] += rng.choice([0, 0, 1, 7, 100])
+        op = rng.random()
+        if op < 0.35:
+            js[src].emit("slot", op="acquire", slot=0, alloc=0)
+            n_events += 1
+        elif op < 0.7:
+            dst = rng.choice([p for p in procs if p != src])
+            mbx = f"ctl.{dst}"
+            mseq[dst] += 1
+            wire = js[src].wire_emit("mbx_send", mailbox=mbx,
+                                     mseq=mseq[dst], msg_kind="submit")
+            n_events += 1
+            in_flight.append((dst, mbx, mseq[dst], wire))
+            if rng.random() < 0.15:   # duplicated delivery
+                in_flight.append((dst, mbx, mseq[dst], wire))
+        elif in_flight:
+            # reordered delivery: pop a RANDOM in-flight message
+            dst, mbx, k, wire = in_flight.pop(
+                rng.randrange(len(in_flight)))
+            if rng.random() < 0.6:
+                clocks[dst][0] += rng.choice([0, 1, 30])
+            js[dst].recv_emit(wire, "mbx_recv", mailbox=mbx, mseq=k,
+                              msg_kind="submit")
+            n_events += 1
+    while in_flight:   # drain the tail
+        dst, mbx, k, wire = in_flight.pop(rng.randrange(len(in_flight)))
+        js[dst].recv_emit(wire, "mbx_recv", mailbox=mbx, mseq=k,
+                          msg_kind="submit")
+        n_events += 1
+    for j in js.values():
+        j.close()
+
+    merged = jr.merge_journals(str(tmp_path))
+    assert merged["schema"] == jr.MERGE_SCHEMA
+    assert sorted(merged["procs"]) == sorted(procs)
+    evs = merged["events"]
+    assert len(evs) == n_events
+    # total order: sorted by sort_key, idx-annotated
+    keys = [jr.sort_key(e) for e in evs]
+    assert keys == sorted(keys)
+    assert [e["idx"] for e in evs] == list(range(len(evs)))
+    # consistent with every per-process order (seq AND strict HLC)
+    for p in procs:
+        mine = [e for e in evs if e["proc"] == p]
+        seqs = [e["seq"] for e in mine]
+        assert seqs == sorted(seqs)
+        stamps = [tuple(e["hlc"]) for e in mine]
+        assert all(a < b for a, b in zip(stamps, stamps[1:]))
+    # consistent with every send→receive edge: src strictly before dst
+    sends = sum(1 for e in evs if e["kind"] == "mbx_send")
+    recvs = [e for e in evs if e["kind"] == "mbx_recv"]
+    assert sends and len(merged["edges"]) == len(recvs)
+    for ed in merged["edges"]:
+        src, dst = evs[ed["src"]], evs[ed["dst"]]
+        assert ed["src"] < ed["dst"]
+        assert tuple(src["hlc"]) < tuple(dst["hlc"])
+
+
+# ---------------------------------------------------------------------------
+# synthetic two-process run: the conformance fixture
+# ---------------------------------------------------------------------------
+
+def _synthetic_run(tmp_path, *, zombie=False, double_finish=False,
+                   shed_after_done=False):
+    """One request's life across a router and a worker journal; with
+    ``zombie=True`` the run includes a fence + post-fence beat whose
+    write the router correctly REFUSES (the real protocol's behavior —
+    only a mutated model makes it land)."""
+    router = jr.Journal(str(tmp_path / "journal.router.jsonl"), "router")
+    w0 = jr.Journal(str(tmp_path / "journal.w0.jsonl"), "w0")
+    tid = "req-t-00000001"
+    router.emit("fleet", event="submitted", trace_id=tid, worker="w0")
+    wire = router.wire_emit("mbx_send", mailbox="ctl.w0", mseq=1,
+                            msg_kind="submit", trace_id=tid)
+    w0.recv_emit(wire, "mbx_recv", mailbox="ctl.w0", mseq=1,
+                 msg_kind="submit", trace_id=tid)
+    w0.emit("slot", op="init", alloc=0, n_slots=2)
+    w0.emit("slot", op="acquire", alloc=0, slot=0)
+    beat = w0.wire_emit("beat", worker="w0", epoch=1, lseq=1)
+    router.recv_emit(beat, "lease_judged", worker="w0", epoch=1,
+                     lseq=1, admitted=True)
+    w0.emit("slot", op="release", alloc=0, slot=0)
+    router.emit("fleet", event="finished", trace_id=tid, worker="w0",
+                reason="eos")
+    if double_finish:
+        router.emit("fleet", event="finished", trace_id=tid,
+                    worker="w0", reason="eos")
+    if shed_after_done:
+        router.emit("fleet", event="shed", trace_id=tid)
+    if zombie:
+        router.emit("fence", worker="w0", epoch=1)
+        beat2 = w0.wire_emit("beat", worker="w0", epoch=1, lseq=2)
+        router.recv_emit(beat2, "lease_judged", worker="w0", epoch=1,
+                         lseq=2, admitted=False)
+    router.close()
+    w0.close()
+    return tid
+
+
+def test_conformance_clean_run_ok(tmp_path):
+    _synthetic_run(tmp_path, zombie=True)
+    report = check_dir(str(tmp_path))
+    assert report["ok"], render_report(report)
+    assert report["violations"] == []
+    assert report["checked"]["done_xor_shed"] == 1
+    assert report["checked"]["lease_fence"] == 1
+    assert report["checked"]["slot_lifecycle"] == 1
+    assert render_report(report).startswith("conformance: OK")
+
+
+def test_conformance_catches_done_and_shed(tmp_path):
+    _synthetic_run(tmp_path, shed_after_done=True)
+    report = check_dir(str(tmp_path))
+    assert not report["ok"]
+    v = report["violations"][0]
+    assert v["model"] == "done_xor_shed"
+    assert v["chain"], v
+
+
+def test_conformance_catches_double_finish(tmp_path):
+    _synthetic_run(tmp_path, double_finish=True)
+    report = check_dir(str(tmp_path))
+    assert not report["ok"]
+    assert any(v["model"] == "done_xor_shed"
+               for v in report["violations"])
+
+
+def test_mutation_injected_zombie_write_caught(tmp_path):
+    """The ISSUE 17 acceptance drill: un-fence the lease_fence model's
+    delivery guard via ``Model.replace`` and the monitor must catch the
+    zombie write the REAL run refused — with a minimal causal chain
+    whose offending edge is the zombie beat → lease_judged HLC pair."""
+    _synthetic_run(tmp_path, zombie=True)
+    merged = jr.merge_journals(str(tmp_path))
+    assert check_conformance(merged)["ok"]   # the real protocol holds
+
+    def land_all(model: protocol.Model) -> protocol.Model:
+        # deliver_write ignores the fence/epoch guard entirely: every
+        # pending write lands, zombie or not
+        def apply(s):
+            e, z = s.pending[0]
+            return s._replace(pending=s.pending[1:],
+                              landed=s.landed + ((e, z),))
+        return model.replace("fence.deliver_write", apply=apply)
+
+    report = check_conformance(merged, mutate={"lease_fence": land_all})
+    assert not report["ok"]
+    v = next(v for v in report["violations"]
+             if v["model"] == "lease_fence")
+    assert "FENCED WRITER LANDED" in v["reason"]
+    # minimal causal chain, rendered as journal lines
+    assert v["chain"] and any("fence" in line for line in v["chain"])
+    # ...naming the offending happens-before edge: the zombie beat's
+    # wire stamp and the router's merged judgment stamp
+    edge = v["edge"]
+    assert edge["kind"] == "lease"
+    evs = merged["events"]
+    assert evs[edge["src"]]["kind"] == "beat"
+    assert evs[edge["src"]]["lseq"] == 2
+    assert evs[edge["dst"]]["kind"] == "lease_judged"
+    assert tuple(edge["src_hlc"]) < tuple(edge["dst_hlc"])
+    rendered = render_report(report)
+    assert "FENCED WRITER LANDED" in rendered
+    assert "offending happens-before edge" in rendered
+
+
+# ---------------------------------------------------------------------------
+# one request's causal story (explain_bundle --request)
+# ---------------------------------------------------------------------------
+
+def test_request_story_renders_cross_process_chain(tmp_path):
+    tid = _synthetic_run(tmp_path)
+    merged = jr.merge_journals(str(tmp_path))
+    story = jr.request_story(merged, tid)
+    assert story["procs"] == ["router", "w0"]
+    assert story["workers"] == ["w0"]
+    assert story["outcome"] == {"kind": "done", "worker": "w0",
+                                "reason": "eos"}
+    text = jr.render_request_story(story)
+    assert tid in text and "happens-after" in text
+    assert "outcome: done on w0" in text
+    # the CLI face: explain_bundle --request over a merged-journal file
+    out_json = str(tmp_path / "merged.json")
+    jr.merge_journals(str(tmp_path), out_path=out_json)
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "scripts",
+                                      "explain_bundle.py"),
+         out_json, "--request", tid],
+        capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stderr
+    assert tid in r.stdout and "happens-after" in r.stdout
+
+
+def test_export_perfetto_one_lane_per_proc(tmp_path):
+    _synthetic_run(tmp_path)
+    merged = jr.merge_journals(str(tmp_path))
+    out = str(tmp_path / "journal_trace.json")
+    jr.export_perfetto(merged, out)
+    with open(out) as f:
+        doc = json.load(f)
+    names = {e["args"]["name"] for e in doc["traceEvents"]
+             if e.get("ph") == "M" and e.get("name") == "process_name"}
+    assert {"journal:router", "journal:w0"} <= names
+
+
+# ---------------------------------------------------------------------------
+# the CLI's exit contract (wired into `pytest -m lint`)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.lint
+def test_check_conformance_cli_exit_codes(tmp_path):
+    def run(*argv):
+        return subprocess.run([sys.executable, CLI, *argv],
+                              capture_output=True, text=True,
+                              timeout=60)
+    # 2: unusable input (no such dir / no journals in it)
+    assert run(str(tmp_path / "nope")).returncode == 2
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert run(str(empty)).returncode == 2
+    # 0: clean journals
+    clean = tmp_path / "clean"
+    clean.mkdir()
+    _synthetic_run(clean)
+    r = run(str(clean), "--json")
+    assert r.returncode == 0, r.stderr
+    assert json.loads(r.stdout)["ok"] is True
+    # 1: violations
+    bad = tmp_path / "bad"
+    bad.mkdir()
+    _synthetic_run(bad, shed_after_done=True)
+    r = run(str(bad))
+    assert r.returncode == 1
+    assert "VIOLATION" in r.stdout
+
+
+# ---------------------------------------------------------------------------
+# satellites: flight-ring drop counters, /requestz tenancy columns
+# ---------------------------------------------------------------------------
+
+def test_flight_ring_overflow_counted_per_kind(tmp_path):
+    rec = _flight.get_flight_recorder()
+    rec.clear()
+    try:
+        for i in range(rec.capacity):
+            _flight.note("ovf_filler", i=i)
+        for i in range(25):
+            _flight.note("ovf_probe", i=i)
+        d = rec.dropped_counts()
+        assert sum(d.values()) == 25 and d["ovf_filler"] == 25
+        # /metricsz exposes the loss as flight/dropped/* gauges
+        text = StatusServer().metricsz()
+        assert "flight_dropped_ovf_filler" in text
+        # and the bundle MANIFEST carries the same accounting
+        bundle = _flight.dump_bundle(str(tmp_path), "test")
+        with open(os.path.join(bundle, "MANIFEST.json")) as f:
+            manifest = json.load(f)
+        assert manifest["ring_dropped_by_kind"]["ovf_filler"] == 25
+    finally:
+        rec.clear()
+
+
+def test_requestz_row_always_has_tenancy_columns():
+    bare = _request_row(Request([1, 2, 3], 4))
+    assert (bare["tenant"], bare["priority"], bare["rung"]) == \
+        (None, None, None)
+    req = Request([1, 2, 3], 4, tenant="acme")
+    req.priority = 2
+    req.rung = 1
+    row = _request_row(req)
+    assert (row["tenant"], row["priority"], row["rung"]) == ("acme", 2, 1)
+
+
+def test_flight_tee_journals_notes_but_not_spans(tmp_path):
+    jr.configure(str(tmp_path), "p0")
+    _flight.note("span", name="x", dur_ms=1.0)
+    _flight.note("instant", name="y")
+    _flight.note("fleet", event="submitted", trace_id="t")
+    jr.reset()
+    evs = jr.read_journal(str(tmp_path / "journal.p0.jsonl"))
+    kinds = [e["kind"] for e in evs]
+    assert kinds == ["fleet"]
+    assert evs[0]["event"] == "submitted"
