@@ -633,8 +633,15 @@ def bench_serving():
         jax.random.PRNGKey(0), vocab, d_model, n_heads, n_layers,
         max_len=s_p + new, pos_impl="rope")
     mesh = mn.make_nd_mesh(("model",), (1,), jax.devices()[:1])
-    rs = np.random.RandomState(0)
-    prompts = rs.randint(0, vocab, (n_requests, s_p)).astype(np.int32)
+    # ONE seeded arrival source (ISSUE 18 satellite): the scenario
+    # engine's staggered generator replaces the hand-rolled loop —
+    # event t is in virtual units; each load point scales a unit to
+    # submit_every engine steps
+    from chainermn_tpu.serving import scenarios as _sc
+    arrivals = _sc.staggered(n_requests, 1.0, seed=0, prompt_len=s_p,
+                             max_new_tokens=new)
+    prompts = [np.asarray(_sc.materialize_prompt(ev["prompt"], vocab),
+                          np.int32) for ev in arrivals]
 
     def run_point(submit_every):
         eng = ServingEngine(params, head_dim=d_model // n_heads,
@@ -651,9 +658,11 @@ def bench_serving():
         nxt, steps = 0, 0
         while nxt < n_requests or eng.pool.busy_count > 0 \
                 or eng.scheduler.queue_depth > 0:
-            if nxt < n_requests and steps % submit_every == 0:
+            if nxt < n_requests and steps % submit_every == 0 \
+                    and steps >= arrivals[nxt]["t"] * submit_every:
                 try:
-                    eng.submit(prompts[nxt], new)
+                    eng.submit(prompts[nxt],
+                               arrivals[nxt]["max_new_tokens"])
                 except AdmissionError:
                     pass  # backpressure counted in rejected_total
                 else:
@@ -1048,11 +1057,16 @@ def bench_serving_autoscale():
         jax.random.PRNGKey(0), vocab, d_model, n_heads, n_layers,
         max_len=s_p + new, pos_impl="rope")
     mesh = mn.make_nd_mesh(("model",), (1,), jax.devices()[:1])
-    rs = np.random.RandomState(0)
-    prompts = [rs.randint(0, vocab, s_p).astype(np.int32)
-               for _ in range(16)]
     wk = dict(n_slots=4, max_total=s_p + new, queue_capacity=8,
               mesh=mesh)
+
+    # ONE seeded arrival source: the diurnal curve, its gold/free
+    # alternation and every prompt come from the scenario engine —
+    # this section no longer hand-rolls its arrival loop
+    from chainermn_tpu.serving import scenarios as _sc
+    by_phase = {}
+    for ev in _sc.diurnal(0, prompt_len=s_p, max_new_tokens=new):
+        by_phase.setdefault(ev["phase"], []).append(ev)
 
     tenancy = TenantTable()
     tenancy.register("gold", "paid")
@@ -1099,16 +1113,17 @@ def bench_serving_autoscale():
 
     sheds = {"gold": 0, "free": 0}
 
-    def offer(n, gap_s):
+    def offer(events, gap_s):
         handles = []
-        for i in range(n):
-            tenant = "gold" if i % 2 == 0 else "free"
+        for ev in events:
+            prompt = np.asarray(
+                _sc.materialize_prompt(ev["prompt"], vocab), np.int32)
             try:
                 handles.append(submit_with_retry(
-                    router.submit, prompts[i % len(prompts)], new,
-                    tenant=tenant, max_attempts=2))
+                    router.submit, prompt, ev["max_new_tokens"],
+                    tenant=ev["tenant"], max_attempts=2))
             except AdmissionError:
-                sheds[tenant] += 1
+                sheds[ev["tenant"]] += 1
             time.sleep(gap_s)
         return handles
 
@@ -1119,16 +1134,16 @@ def bench_serving_autoscale():
             time.sleep(0.005)
 
     # warm the first worker's compiles outside the measured window
-    wait_done(offer(2, 0.0))
+    wait_done(offer(_sc.diurnal(1, phases=(("warm", 2, 0.0),),
+                                prompt_len=s_p,
+                                max_new_tokens=new), 0.0))
 
     # diurnal curve + burst: (phase, requests, interarrival seconds)
-    phases = [("night", 3, 0.05), ("morning", 10, 0.005),
-              ("peak_burst", 20, 0.0), ("evening", 6, 0.02),
-              ("night2", 3, 0.05)]
+    phases = _sc.DIURNAL_PHASES
     worker_trace = []
     all_handles = []
     for name, n_req, gap_s in phases:
-        hs = offer(n_req, gap_s)
+        hs = offer(by_phase[name], gap_s)
         all_handles.extend(hs)
         if name == "peak_burst":
             # the burst's backlog is the scale-up evidence — sample
@@ -1576,6 +1591,242 @@ def bench_serving_kv_economy():
         "reprefill_ms": round(reprefill_ms, 2),
         "spill_restore_ms": round(restore_ms, 2),
     }
+
+
+def bench_serving_scenarios():
+    """Scenario-plane perf (ISSUE 18, docs/SERVING.md "Scenario engine
+    & heterogeneous fleet"): seeded, replayable workloads against the
+    REAL fleet, plus the zero-shed rolling weight upgrade, on the gate.
+
+    Four scenario matrix rows (each on a FRESH small fleet so the
+    metrics are per-scenario, each under its own causal journal):
+
+    * ``diurnal`` — the offered-load curve the autoscale section also
+      drives, replayed from the ONE seeded arrival source.
+    * ``flash_crowd`` — steady background + a shared-prefix burst.
+    * ``adversarial`` — prefix-sniping + long-prompt hog tenants
+      against a paid tenant; the acceptance bound is QoS isolation:
+      ``tenant_gold_degraded == 0`` (no rung ever clamps the paid
+      tenant) while best-effort absorbs the ladder.
+    * ``composed_chaos`` — worker kill + flash crowd + SIGSTOP zombie
+      in ONE run, on a 2-worker fleet.
+
+    Then the upgrade: a checkpoint-v2 generation (saved SHARDED,
+    installed through ``reshard_host``) rolls across a live 2-worker
+    fleet — ``rolling_upgrade/drain_shed`` gates at 0 and
+    ``parity_violations`` counts pinned pre/post token divergence.
+
+    Every-backend contract; ``shed_rate``/``slo_burn``/``max_rung``/
+    ``flap``/``drain_shed``/``*_degraded``/``*_violations`` keys gate
+    lower-is-better in bench_history.jsonl.  ``repro_violations``
+    counts same-seed digest mismatches (the replayability bound, 0);
+    ``conformance_violations`` replays every scenario's journal —
+    including the upgrade window — through the PR 15 protocol models
+    (the acceptance bound is 0).
+    """
+    import shutil
+    import tempfile
+    import threading
+
+    import jax
+    import numpy as np
+
+    import chainermn_tpu as mn
+    from chainermn_tpu.parallel import init_tp_transformer_lm
+    from chainermn_tpu.serving import TenantTable
+    from chainermn_tpu.serving import scenarios as _sc
+    from chainermn_tpu.serving.fleet import (build_local_fleet,
+                                             rolling_upgrade)
+
+    vocab, d_model, n_heads, n_layers = 128, 32, 4, 2
+    s_p, new = 16, 8
+    params = init_tp_transformer_lm(
+        jax.random.PRNGKey(0), vocab, d_model, n_heads, n_layers,
+        max_len=64, pos_impl="rope")
+    mesh = mn.make_nd_mesh(("model",), (1,), jax.devices()[:1])
+    # max_total 64 covers the adversarial hog's near-capacity prompts
+    wk = dict(n_slots=4, max_total=64, queue_capacity=24, mesh=mesh)
+
+    from chainermn_tpu.observability import journal as _journal
+    from chainermn_tpu.observability.conform import (check_dir,
+                                                     render_report)
+    jroot = tempfile.mkdtemp(prefix="bench-scenario-journal-")
+
+    # same seed must reproduce the byte-identical stream — gated as an
+    # int violation counter (the gate's _flatten drops booleans)
+    specs = {
+        "diurnal": dict(prompt_len=s_p, max_new_tokens=new,
+                        deadline_s=10.0),
+        "flash_crowd": dict(prompt_len=s_p, max_new_tokens=new,
+                            deadline_s=10.0),
+        "adversarial": dict(prompt_len=s_p, max_new_tokens=new,
+                            long_prompt_len=48),
+        "composed_chaos": dict(prompt_len=s_p, max_new_tokens=new,
+                               deadline_s=10.0),
+    }
+    repro_violations = 0
+    streams = {}
+    for name, kw in specs.items():
+        streams[name] = _sc.build_scenario(name, seed=0, **kw)
+        if _sc.stream_digest(streams[name]) != _sc.stream_digest(
+                _sc.build_scenario(name, seed=0, **kw)):
+            repro_violations += 1
+
+    conformance_violations = 0
+    conformance_checked = 0
+
+    def run_one(name, *, n_workers=1, tenants=(), faults=False):
+        nonlocal conformance_violations, conformance_checked
+        tenancy = None
+        if tenants:
+            tenancy = TenantTable()
+            for tname, cls, cap in tenants:
+                budgets = {} if cap is None else {"max_inflight": cap}
+                tenancy.register(tname, cls, **budgets)
+        jdir = os.path.join(jroot, name)
+        _journal.configure(jdir, "bench")
+        router, runtimes = build_local_fleet(
+            params, {"engine": n_workers}, head_dim=d_model // n_heads,
+            # wide lease window: in-process prefill compiles stall the
+            # GIL for seconds and the scenarios measure workload
+            # response, not detection latency (composed_chaos's kill
+            # still detects — its settle window dwarfs 0.85 s)
+            beat_interval_s=0.05, miss_beats=16, worker_kwargs=wk,
+            tenancy=tenancy)
+        threads = [threading.Thread(target=rt.run, daemon=True)
+                   for rt in runtimes]
+        for t in threads:
+            t.start()
+        router.start()
+        try:
+            # warm every prompt-length compile outside the window
+            for plen in sorted({ev["prompt"]["len"]
+                                for ev in streams[name]
+                                if ev["kind"] == "request"}):
+                h = router.submit(np.zeros(plen, np.int32), 2)
+                t0 = time.time()
+                while (h.status not in ("done", "evicted")
+                       and time.time() - t0 < 30):
+                    time.sleep(0.005)
+            router.reset_stats()
+            out = _sc.run_scenario(
+                streams[name], router, vocab=vocab,
+                runtimes=runtimes if faults else (),
+                tenancy=tenancy, max_attempts=2, settle_timeout_s=60.0)
+        finally:
+            router.stop()
+            for rt in runtimes:
+                rt.finished = True
+            for t in threads:
+                t.join(timeout=5)
+            router.close()
+            _journal.reset()
+        report = check_dir(jdir)
+        conformance_checked += int(sum(report["checked"].values()))
+        if not report["ok"]:
+            conformance_violations += len(report["violations"])
+            print(render_report(report), file=sys.stderr)
+        return out
+
+    result = {}
+    try:
+        # 2 workers: the peak burst must land in queue capacity, not
+        # overflow into worker-side shed-backs (the scenario measures
+        # the curve's response, not an undersized fleet's collapse)
+        result["diurnal"] = run_one("diurnal", n_workers=2)
+        result["flash_crowd"] = run_one("flash_crowd", n_workers=2)
+        result["adversarial"] = run_one(
+            "adversarial",
+            tenants=(("gold", "paid", None),
+                     ("sniper", "best_effort", 2),
+                     ("hog", "best_effort", 2)))
+        result["composed_chaos"] = run_one("composed_chaos",
+                                           n_workers=2, faults=True)
+
+        # --- rolling weight upgrade on a live 2-worker fleet ----------
+        jdir = os.path.join(jroot, "rolling_upgrade")
+        _journal.configure(jdir, "bench")
+        router, runtimes = build_local_fleet(
+            params, {"engine": 2}, head_dim=d_model // n_heads,
+            beat_interval_s=0.05, miss_beats=16, worker_kwargs=wk)
+        threads = [threading.Thread(target=rt.run, daemon=True)
+                   for rt in runtimes]
+        for t in threads:
+            t.start()
+        router.start()
+        try:
+            pinned = np.arange(s_p, dtype=np.int32) % vocab
+
+            def decode_pinned():
+                h = router.submit(pinned, new)
+                t0 = time.time()
+                while (h.status not in ("done", "evicted")
+                       and time.time() - t0 < 30):
+                    time.sleep(0.005)
+                return list(h.tokens)
+
+            before = decode_pinned()
+            # checkpoint v2: the same values RE-SAVED by a 2-process
+            # world with the embedding row-sharded — reshard_host must
+            # reassemble them bit-for-bit on install
+            params_np = jax.tree_util.tree_map(np.asarray, params)
+            layout = jax.tree_util.tree_map(lambda x: None, params_np)
+            layout["embed"] = 0
+            shards = []
+            for i in range(2):
+                s = jax.tree_util.tree_map(lambda x: x, params_np)
+                s["embed"] = np.split(params_np["embed"], 2, axis=0)[i]
+                shards.append(s)
+            t_up = time.time()
+            report = rolling_upgrade(
+                router, runtimes, shards, layout, generation=2,
+                head_dim=d_model // n_heads, worker_kwargs=wk,
+                timeout_s=60.0)
+            upgrade_wall_s = time.time() - t_up
+            after = decode_pinned()
+            m = router.metrics()
+            result["rolling_upgrade"] = {
+                "upgraded": len(report["upgraded"]),
+                "upgrade_wall_s": round(upgrade_wall_s, 3),
+                # the acceptance bound: a drain sheds NOTHING
+                "drain_shed": int(report["drain_shed"]),
+                "rejected_during_upgrade": int(report["rejected_delta"]),
+                # pinned pre/post token divergence (bound: 0)
+                "parity_violations": int(before != after),
+                "live_generation": max(
+                    w.weights_generation
+                    for w in router.workers.values()
+                    if w.state in ("starting", "live")),
+                "fenced_refusals": int(sum(
+                    v for k, v in m.items()
+                    if k.startswith("fleet/fenced_refusals/"))),
+            }
+        finally:
+            router.stop()
+            for rt in runtimes:
+                rt.finished = True
+            for t in threads:
+                t.join(timeout=5)
+            router.close()
+            _journal.reset()
+        report = check_dir(jdir)
+        conformance_checked += int(sum(report["checked"].values()))
+        if not report["ok"]:
+            conformance_violations += len(report["violations"])
+            print(render_report(report), file=sys.stderr)
+    finally:
+        shutil.rmtree(jroot, ignore_errors=True)
+
+    result.update({
+        "config": f"per-scenario fleets (1-2 engine workers), "
+                  f"d{d_model} L{n_layers} V{vocab} prompt{s_p} "
+                  f"new{new}, seed 0, beat 50ms × miss 16, "
+                  f"loopback lanes",
+        "repro_violations": repro_violations,
+        "conformance_violations": conformance_violations,
+        "conformance_checked": conformance_checked,
+    })
+    return result
 
 
 def bench_elastic_resume():
@@ -2705,6 +2956,7 @@ def main():
         "serving_chaos": None,
         "serving_autoscale": None,
         "serving_kv_economy": None,
+        "serving_scenarios": None,
         "train_chaos": None,
         "data_path": None,
         "long_context": None,
@@ -2770,6 +3022,12 @@ def main():
             "kv_economy_prefills_per_prefix": g(
                 result, "serving_kv_economy",
                 "prefill_calls_per_unique_prefix"),
+            "scenario_adversarial_gold_degraded": g(
+                result, "serving_scenarios", "adversarial",
+                "tenant_gold_degraded"),
+            "scenario_upgrade_drain_shed": g(
+                result, "serving_scenarios", "rolling_upgrade",
+                "drain_shed"),
             "train_chaos_detection_ms": g(result, "train_chaos",
                                           "detection_ms"),
             "train_chaos_reconfig_ms": g(result, "train_chaos",
@@ -2986,6 +3244,24 @@ def main():
             emit()
     else:
         print("bench: over budget — serving_kv_economy section skipped",
+              file=sys.stderr)
+
+    # --- scenario plane: seeded workloads + rolling upgrade (ISSUE 18) -----
+    # Every-backend contract; shed_rate/slo_burn/max_rung/flap/drain_shed/
+    # *_degraded/*_violations keys gate lower-is-better in
+    # bench_history.jsonl — the acceptance bounds are
+    # rolling_upgrade/drain_shed == 0, adversarial/tenant_gold_degraded
+    # == 0, repro_violations == 0, conformance_violations == 0.
+    if not over_budget():
+        try:
+            result["serving_scenarios"] = bench_serving_scenarios()
+            emit("serving_scenarios")
+        except Exception as e:
+            print(f"bench: serving_scenarios section failed: {e!r}",
+                  file=sys.stderr)
+            emit()
+    else:
+        print("bench: over budget — serving_scenarios section skipped",
               file=sys.stderr)
 
     # --- train chaos: rank death -> live shrink cost (ISSUE 13) ------------

@@ -259,6 +259,7 @@ DxsState = namedtuple("DxsState", [
     "results",      # frozenset[(worker, attempt)] — in-flight results
     "done",         # terminal done count (must stay <= 1)
     "shed",         # terminal shed count (must stay <= 1)
+    "returned",     # a LIVE owner gave the request back (queue_full)
 ])
 
 
@@ -280,7 +281,7 @@ def make_done_xor_shed_model(n_workers: int = 2,
             alive=tuple(True for _ in W),
             detected=tuple(False for _ in W),
             has_req=tuple(None for _ in W),
-            results=frozenset(), done=0, shed=0)
+            results=frozenset(), done=0, shed=0, returned=False)
         base.update(kw)
         return DxsState(**base)
 
@@ -321,10 +322,23 @@ def make_done_xor_shed_model(n_workers: int = 2,
             lambda s, w=w: not s.alive[w] and not s.detected[w],
             lambda s, w=w: s._replace(
                 detected=tup_set(s.detected, w, True))))
+        # give-back: a LIVE owner sheds the dispatched request back to
+        # the router (worker-side queue_full backpressure) — ownership
+        # returns WITHOUT a death, and the router may then re-dispatch
+        # or shed (the scenario plane's burst workloads drive this)
+        ts.append(Transition(
+            f"worker{w}.give_back",
+            lambda s, w=w: (
+                s.registered and s.done + s.shed == 0
+                and s.alive[w] and s.owner == w
+                and s.has_req[w] is not None),
+            lambda s, w=w: s._replace(
+                has_req=tup_set(s.has_req, w, None), returned=True)))
 
     # failover: the supervisor owns re-dispatch (mark_dead loop + the
     # orphan sweep both funnel here) — enabled whenever the current
-    # owner is detected dead and the entry has no outcome yet
+    # owner is detected dead OR gave the request back, and the entry
+    # has no outcome yet
     for w in W:
         for v in W:
             if v == w:
@@ -333,17 +347,19 @@ def make_done_xor_shed_model(n_workers: int = 2,
                 f"supervisor.failover(w{w}->w{v})",
                 lambda s, w=w, v=v: (
                     s.registered and s.done + s.shed == 0
-                    and s.owner == w and s.detected[w]
+                    and s.owner == w
+                    and (s.detected[w] or s.returned)
                     and s.attempts < max_attempts
                     and not s.detected[v]),
                 lambda s, w=w, v=v: s._replace(
-                    owner=v, attempts=s.attempts + 1,
+                    owner=v, attempts=s.attempts + 1, returned=False,
                     has_req=tup_set(s.has_req, v, s.attempts + 1))))
         ts.append(Transition(
             f"supervisor.shed(w{w})",
             lambda s, w=w: (
                 s.registered and s.done + s.shed == 0
-                and s.owner == w and s.detected[w]
+                and s.owner == w
+                and (s.detected[w] or s.returned)
                 and (s.attempts >= max_attempts
                      or all(s.detected[v] for v in W if v != w))),
             lambda s, w=w: s._replace(shed=s.shed + 1)))

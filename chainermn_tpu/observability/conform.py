@@ -172,14 +172,23 @@ def replay_done_xor_shed(merged: Dict[str, Any],
                 (pos, ev, ("submit", (str(w), inc.get(w, 0)))))
         elif event == "redispatched":
             to = ev.get("to")
+            # a re-dispatch caused by a LIVE worker's shed-back
+            # (queue_full backpressure) is a give-back + failover, not
+            # a death failover — the why names the worker-side shed
+            op = ("giveback_failover" if "shed:" in str(ev.get("why"))
+                  else "failover")
             per_trace.setdefault(tid, []).append(
-                (pos, ev, ("failover", (str(to), inc.get(to, 0)))))
+                (pos, ev, (op, (str(to), inc.get(to, 0)))))
         elif event == "finished":
             per_trace.setdefault(tid, []).append(
                 (pos, ev, ("finished", (str(w), inc.get(w, 0)))))
         elif event == "shed":
+            detail = str((ev.get("payload") or {}).get("detail"))
+            op = ("giveback_shed"
+                  if detail.startswith("worker") and "shed:" in detail
+                  else "shed")
             per_trace.setdefault(tid, []).append(
-                (pos, ev, ("shed", None)))
+                (pos, ev, (op, None)))
 
     violations: List[Dict[str, Any]] = []
     incomplete: List[str] = []
@@ -193,7 +202,7 @@ def replay_done_xor_shed(merged: Dict[str, Any],
         if not universe:
             continue   # nothing dispatch-shaped journaled (torn head)
         n_failovers = sum(1 for _, _, (op, _) in items
-                          if op == "failover")
+                          if op in ("failover", "giveback_failover"))
         model = _mutated(make_done_xor_shed_model, mutator,
                          n_workers=len(universe),
                          max_attempts=1 + n_failovers)
@@ -219,11 +228,15 @@ def replay_done_xor_shed(merged: Dict[str, Any],
                 i = idx(who)
                 bad = (r.try_step(f"worker{i}.dies", ev)
                        or r.try_step(f"supervisor.detect(w{i})", ev))
-            elif op == "failover":
+            elif op in ("failover", "giveback_failover"):
                 cur = r.state.owner
                 if cur is None:
                     bad = "failover of a request with no owner"
                 else:
+                    if op == "giveback_failover":
+                        # the live owner returned the request first
+                        # (no-op if the model already saw it die)
+                        r.try_step(f"worker{cur}.give_back", ev)
                     bad = r.step(
                         f"supervisor.failover(w{cur}->w{idx(who)})", ev)
             elif op == "finished":
@@ -241,12 +254,14 @@ def replay_done_xor_shed(merged: Dict[str, Any],
                                or r.step(
                                    f"router.deliver_result(w{i},"
                                    f"att{att})", ev))
-            elif op == "shed":
+            elif op in ("shed", "giveback_shed"):
                 cur = r.state.owner
                 if cur is None:
                     bad = r.try_step("submit(reject:no_live_worker)",
                                      ev) or None
                 else:
+                    if op == "giveback_shed":
+                        r.try_step(f"worker{cur}.give_back", ev)
                     bad = r.step(f"supervisor.shed(w{cur})", ev)
             if bad:
                 violations.append(_violation(

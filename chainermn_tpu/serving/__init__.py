@@ -87,6 +87,22 @@ Fleet KV economy (ISSUE 12; docs/SERVING.md "Fleet KV economy"):
   (CRC-verified ``kv_transfer.v1`` payloads); a later hit restores
   through the compiled inject path instead of re-prefilling.
 
+Scenario plane & heterogeneous fleet (ISSUE 18; docs/SERVING.md
+"Scenario engine & heterogeneous fleet"):
+
+* :mod:`~chainermn_tpu.serving.scenarios` — the seeded, replayable
+  workload engine: jax-free generators (diurnal, flash crowd,
+  adversarial tenants, mixed deadlines, composed chaos) emitting the
+  deterministic ``chainermn_tpu.scenario.v1`` event stream, plus
+  :func:`~chainermn_tpu.serving.scenarios.run_scenario` replaying it
+  in scaled wall-clock against a real fleet.
+* :mod:`~chainermn_tpu.serving.models` — :class:`ModelRegistry`:
+  multiple model variants (and weight GENERATIONS) behind one
+  :class:`FleetRouter`; ``model_id`` rides the hello/lease wire, and
+  :func:`~chainermn_tpu.serving.fleet.rolling_upgrade` installs a new
+  checkpoint generation worker-by-worker with zero restart and zero
+  shed (docs/ROBUSTNESS.md "Rolling weight upgrade").
+
 ``python -m chainermn_tpu.serve`` is the CLI demo over the toy-corpus
 LM from ``examples/generate`` (``--replicas N`` stands up the fleet,
 ``--disagg P:D`` the disaggregated topology, ``--fleet-procs N`` the
@@ -119,7 +135,10 @@ __all__ = ["AdmissionError", "Request", "Scheduler", "SlotAllocator",
            "DecodeWorker", "build_disagg_fleet",
            "FileLaneStore", "WorkerRuntime", "FleetRouter",
            "WorkerClient", "build_proc_fleet", "build_local_fleet",
-           "submit_with_retry"]
+           "submit_with_retry", "rolling_upgrade",
+           "ModelRegistry", "ModelVariant",
+           "SCENARIO_SCHEMA", "build_scenario", "run_scenario",
+           "stream_digest", "materialize_prompt"]
 
 
 def __getattr__(name):
@@ -155,9 +174,17 @@ def __getattr__(name):
         from .worker import WorkerRuntime
         return WorkerRuntime
     if name in ("FleetRouter", "WorkerClient", "build_proc_fleet",
-                "build_local_fleet", "submit_with_retry"):
+                "build_local_fleet", "submit_with_retry",
+                "rolling_upgrade"):
         from . import fleet
         return getattr(fleet, name)
+    if name in ("ModelRegistry", "ModelVariant"):
+        from . import models
+        return getattr(models, name)
+    if name in ("SCENARIO_SCHEMA", "build_scenario", "run_scenario",
+                "stream_digest", "materialize_prompt"):
+        from . import scenarios
+        return getattr(scenarios, name)
     if name in ("AutoscalePolicy", "FleetAutoscaler",
                 "derive_retry_after_ms"):
         from . import autoscale

@@ -115,10 +115,17 @@ class WorkerClient:
     STATES = ("starting", "live", "draining", "drained", "dead")
 
     def __init__(self, name: str, role: str, store, *, epoch: int = 1,
-                 lane_config=None, proc=None, breaker=None):
+                 lane_config=None, proc=None, breaker=None,
+                 model_id: str = "default"):
         self.name = str(name)
         self.role = str(role)
         self.epoch = int(epoch)
+        # heterogeneous-fleet identity (ISSUE 18): seeded at admission,
+        # then ADOPTED from every admitted lease — the worker's claim
+        # on the fenced wire outranks the router's construction-time
+        # guess (same discipline as queue depth)
+        self.model_id = str(model_id)
+        self.weights_generation = 1
         self.sender = MailboxSender(store, ctl_mailbox(name), lane_config)
         self.receiver = MailboxReceiver(store, out_mailbox(name),
                                         lane_config)
@@ -142,6 +149,11 @@ class WorkerClient:
             self._lease_t = time.monotonic()
             self.last_lease = lease
             self.sent_since_lease = 0
+            if lease.get("model_id"):
+                self.model_id = str(lease["model_id"])
+            if lease.get("weights_generation"):
+                self.weights_generation = int(
+                    lease["weights_generation"])
 
     def lease_age_s(self) -> float:
         """Seconds since the last NEW current-epoch lease (or since
@@ -297,12 +309,16 @@ class FleetRouter(RouterBase):
                deadline_s: Optional[float] = None,
                on_token=None, temperature: float = 0.0,
                rng=None, tenant: Optional[str] = None,
-               priority: Optional[str] = None) -> RequestHandle:
+               priority: Optional[str] = None,
+               model_id: Optional[str] = None) -> RequestHandle:
         """Dispatch to the least-loaded live worker over its lane, or
         raise :class:`AdmissionError` with the uniform machine-readable
         payload.  ``tenant``/``priority`` bill the request to a tenant
         class (ISSUE 11): budgets, ladder clamping, and paid-first SLO
-        protection key off them."""
+        protection key off them.  ``model_id`` pins the variant in a
+        heterogeneous fleet (ISSUE 18): only workers serving it are
+        candidates (and failover targets); None routes across ALL
+        variants (the single-model fleet's behavior, unchanged)."""
         import numpy as np
 
         trace_id = self._mint_trace_id()
@@ -320,18 +336,25 @@ class FleetRouter(RouterBase):
                 retry_after_ms=1.0, queue_depth=0)
         role = self._submit_role()
         live = self._live(role)
+        if model_id is not None:
+            live = [w for w in live if w.model_id == model_id]
         if not live:
             self._reject(
-                "worker_lost", trace_id,
+                "worker_lost" if model_id is None else "no_model_worker",
+                trace_id,
                 f"no live {role} worker in the fleet "
-                f"({len(self.workers)} registered)",
+                + (f"serving model {model_id!r} "
+                   if model_id is not None else "")
+                + f"({len(self.workers)} registered)",
                 retry_after_ms=1.0, queue_depth=0)
         depth_of = {}
+        backlog_of = {}
         fleet_cap = 0
         for w in live:
             lease = w.last_lease or {}
             depth_of[w.name] = (int(lease.get("queue_depth", 0))
                                 + w.sent_since_lease)
+            backlog_of[w.name] = int(lease.get("backlog_tokens", 0))
             fleet_cap += int(lease.get("queue_capacity", 0))
         candidates = [
             w for w in live
@@ -354,9 +377,14 @@ class FleetRouter(RouterBase):
                 f"all {len(live)} live {role}-worker queues at capacity",
                 retry_after_ms=self._retry_after_ms(),
                 queue_depth=fleet_depth, tenant=tenant)
+        # least-loaded in TOKEN units (ISSUE 18): queue depth first
+        # (requests are the admission currency), then the lease's
+        # backlog_tokens (variants differ in per-request work — a small
+        # model's worker drains its depth faster), then round-robin
         order = sorted(
             range(len(candidates)),
             key=lambda i: (depth_of[candidates[i].name],
+                           backlog_of[candidates[i].name],
                            (i - self._rr) % len(candidates)))
         wc = candidates[order[0]]
         self._rr = (self._rr + 1) % max(len(candidates), 1)
@@ -372,7 +400,8 @@ class FleetRouter(RouterBase):
         req.status = "running"   # mirror: the worker owns queueing
         req.timestamps["submitted"] = now
         self._stamp_tenant_meta(req, tenant)
-        entry = {"req": req, "worker": wc.name, "attempts": 1}
+        entry = {"req": req, "worker": wc.name, "attempts": 1,
+                 "model_id": wc.model_id}
         # fleet KV economy (ISSUE 12): a local miss with a remote hit
         # may be worth PULLING the prefix slab instead of re-prefilling
         # — decided here, in token units, before anything is sent
@@ -537,8 +566,13 @@ class FleetRouter(RouterBase):
         Returns the pull plan, or None for plain dispatch."""
         if not self.enable_remote_pulls or wc.role != "engine":
             return None
-        live = {w.name for w in self._live("engine")}
-        rec, best_len = self.cache_index.match(prompt, workers=live)
+        # model-keyed claims (ISSUE 18): only same-variant slabs are
+        # candidates — the index counts the cross-model near-miss
+        # under stale_fallbacks/model_mismatch
+        live = {w.name for w in self._live("engine")
+                if w.model_id == wc.model_id}
+        rec, best_len = self.cache_index.match(prompt, workers=live,
+                                               model_id=wc.model_id)
         if rec is None:
             return None
         local_len = self.cache_index.match_for(wc.name, prompt)
@@ -546,6 +580,15 @@ class FleetRouter(RouterBase):
             return None     # the local cache is already as good
         gain = best_len - local_len
         geom = rec.geom or {}
+        # slab-geometry key, belt to the model_id braces: a claim whose
+        # layer/kv/dtype shape disagrees with the DESTINATION's lease
+        # geometry would install garbage — counted, refused, re-prefill
+        dst_geom = (wc.last_lease or {}).get("geom")
+        if geom and dst_geom and any(
+                geom.get(k) != dst_geom.get(k)
+                for k in ("n_layers", "kv_dim", "dtype")):
+            self.cache_index.count_stale("geometry_mismatch")
+            return None
         ledger_bytes = None
         if geom:
             cost = transfer_cost(geom["n_layers"], best_len,
@@ -1187,8 +1230,10 @@ class FleetRouter(RouterBase):
         machine-readably; returns the outcome row the bundle records."""
         req = entry["req"]
         role = self._submit_role()
+        mid = entry.get("model_id")
         survivors = [w for w in self._live(role)
-                     if w.name != entry["worker"]]
+                     if w.name != entry["worker"]
+                     and (mid is None or w.model_id == mid)]
         with self._lock:
             # ownership test + attempts bump are ATOMIC with the
             # submit-path rollback's (membership, attempts==1) check:
@@ -1673,52 +1718,96 @@ def spawn_worker(lane_dir: str, params_file: str, name: str, role: str,
                             stderr=subprocess.STDOUT)
 
 
-def build_proc_fleet(params, topology: Dict[str, int], lane_dir: str, *,
-                     head_dim: int, beat_interval_s: float = 0.05,
+def _resolve_topology(topology, registry):
+    """Normalize ``{role: count-or-[model_id, ...]}`` to per-worker
+    ``(role, index, model_id-or-None)`` rows.  A model_id list needs a
+    :class:`~chainermn_tpu.serving.models.ModelRegistry` (ISSUE 18 —
+    the heterogeneous fleet); a plain int keeps the homogeneous
+    behavior byte-for-byte."""
+    rows = []
+    for role, count in topology.items():
+        if isinstance(count, int):
+            rows += [(role, i, None) for i in range(count)]
+            continue
+        if registry is None:
+            raise ValueError(
+                f"topology role {role!r} lists model_ids {count!r} "
+                f"but no registry= was given")
+        for i, mid in enumerate(count):
+            registry.get(mid)      # refuse unknown ids up front
+            rows.append((role, i, str(mid)))
+    return rows
+
+
+def build_proc_fleet(params, topology: Dict[str, Any], lane_dir: str, *,
+                     head_dim: Optional[int] = None,
+                     beat_interval_s: float = 0.05,
                      miss_beats: int = 4,
                      bundle_dir: Optional[str] = None,
                      journal_dir: Optional[str] = None,
                      worker_kwargs: Optional[Dict[str, Any]] = None,
+                     registry=None,
                      env: Optional[Dict[str, str]] = None,
                      **router_kwargs) -> FleetRouter:
     """Spawn and wire a cross-process gang: ``topology`` maps role →
     count (``{"engine": N}`` for ``serve --fleet-procs N``,
-    ``{"prefill": P, "decode": D}`` for ``--disagg P:D --procs``).
-    The caller drives :meth:`FleetRouter.step` (or ``start()``) and
-    finishes with :meth:`FleetRouter.shutdown`.  ``journal_dir`` turns
-    on the causal HLC journal (ISSUE 17) in the router process AND
-    every spawned worker — merge with
+    ``{"prefill": P, "decode": D}`` for ``--disagg P:D --procs``) OR
+    role → list of model_ids resolved through ``registry`` (ISSUE 18:
+    a heterogeneous fleet — each worker loads ITS variant's params
+    from a per-variant pickle, and ``params``/``head_dim`` may be
+    None).  The caller drives :meth:`FleetRouter.step` (or
+    ``start()``) and finishes with :meth:`FleetRouter.shutdown`.
+    ``journal_dir`` turns on the causal HLC journal (ISSUE 17) in the
+    router process AND every spawned worker — merge with
     :func:`~chainermn_tpu.observability.journal.merge_journals`."""
     from .lanes import FileLaneStore
 
     os.makedirs(lane_dir, exist_ok=True)
     if journal_dir:
         _journal.configure(journal_dir, "router")
-    params_file = write_params_file(
-        os.path.join(lane_dir, "fleet_params.pkl"), params,
-        head_dim=head_dim, **(worker_kwargs or {}))
+    rows = _resolve_topology(topology, registry)
+    params_files: Dict[Optional[str], str] = {}
+    for _, _, mid in rows:
+        if mid in params_files:
+            continue
+        if mid is None:
+            if params is None or head_dim is None:
+                raise ValueError("int topology counts need params= "
+                                 "and head_dim=")
+            params_files[None] = write_params_file(
+                os.path.join(lane_dir, "fleet_params.pkl"), params,
+                head_dim=head_dim, **(worker_kwargs or {}))
+        else:
+            var = registry.get(mid)
+            params_files[mid] = write_params_file(
+                os.path.join(lane_dir, f"fleet_params.{mid}.pkl"),
+                var.params, head_dim=var.head_dim,
+                model_id=var.model_id,
+                weights_generation=var.generation,
+                **dict(worker_kwargs or {}, **var.worker_kwargs))
     store = FileLaneStore(lane_dir)
     clients = []
-    for role, count in topology.items():
-        for i in range(int(count)):
-            name = f"{role}{i}"
-            proc = spawn_worker(lane_dir, params_file, name, role,
-                                epoch=1, beat_interval_s=beat_interval_s,
-                                bundle_dir=bundle_dir,
-                                journal_dir=journal_dir, env=env)
-            clients.append(WorkerClient(name, role, store, epoch=1,
-                                        proc=proc))
+    for role, i, mid in rows:
+        name = f"{role}{i}" if mid is None else f"{role}.{mid}.{i}"
+        proc = spawn_worker(lane_dir, params_files[mid], name, role,
+                            epoch=1, beat_interval_s=beat_interval_s,
+                            bundle_dir=bundle_dir,
+                            journal_dir=journal_dir, env=env)
+        clients.append(WorkerClient(name, role, store, epoch=1,
+                                    proc=proc,
+                                    model_id=mid or "default"))
     return FleetRouter(clients, store,
                        beat_interval_s=beat_interval_s,
                        miss_beats=miss_beats, bundle_dir=bundle_dir,
                        **router_kwargs)
 
 
-def build_local_fleet(params, topology: Dict[str, int], *,
-                      head_dim: int, store=None,
+def build_local_fleet(params, topology: Dict[str, Any], *,
+                      head_dim: Optional[int] = None, store=None,
                       beat_interval_s: float = 0.02, miss_beats: int = 4,
                       bundle_dir: Optional[str] = None,
                       worker_kwargs: Optional[Dict[str, Any]] = None,
+                      registry=None,
                       **router_kwargs):
     """In-process twin of :func:`build_proc_fleet` over the loopback
     store: returns ``(router, runtimes)`` with every worker a
@@ -1726,29 +1815,145 @@ def build_local_fleet(params, topology: Dict[str, int], *,
     steps (or drives on threads).  Same protocol, same fault
     discipline — the fast-tier tests and the ``serving_chaos`` bench
     exercise the real lanes/fencing/failover code without process
-    spawn cost."""
+    spawn cost.  ``topology`` role values may be model_id lists
+    resolved through ``registry`` (heterogeneous fleet, ISSUE 18)."""
     from .transfer import InProcessLaneStore
     from .worker import WorkerRuntime
 
     store = store or InProcessLaneStore()
     runtimes, clients = [], []
-    for role, count in topology.items():
-        for i in range(int(count)):
+    for role, i, mid in _resolve_topology(topology, registry):
+        if mid is None:
+            if params is None or head_dim is None:
+                raise ValueError("int topology counts need params= "
+                                 "and head_dim=")
             name = f"{role}{i}"
             rt = WorkerRuntime(
                 name, role, params, store, head_dim=head_dim, epoch=1,
                 beat_interval_s=beat_interval_s,
                 **(worker_kwargs or {}))
-            # leases flow even when the caller steps the loop manually
-            # (a first-prefill compile blocks a step for seconds —
-            # without the side thread that reads as a missed window);
-            # kill() still silences the thread, preserving the chaos
-            # semantics
-            rt.start_heartbeat()
-            runtimes.append(rt)
-            clients.append(WorkerClient(name, role, store, epoch=1))
+        else:
+            var = registry.get(mid)
+            name = f"{role}.{mid}.{i}"
+            rt = WorkerRuntime(
+                name, role, var.params, store,
+                head_dim=var.head_dim, epoch=1,
+                beat_interval_s=beat_interval_s,
+                model_id=var.model_id,
+                weights_generation=var.generation,
+                **dict(worker_kwargs or {}, **var.worker_kwargs))
+        # leases flow even when the caller steps the loop manually
+        # (a first-prefill compile blocks a step for seconds —
+        # without the side thread that reads as a missed window);
+        # kill() still silences the thread, preserving the chaos
+        # semantics
+        rt.start_heartbeat()
+        runtimes.append(rt)
+        clients.append(WorkerClient(name, role, store, epoch=1,
+                                    model_id=mid or "default"))
     router = FleetRouter(clients, store,
                          beat_interval_s=beat_interval_s,
                          miss_beats=miss_beats, bundle_dir=bundle_dir,
                          **router_kwargs)
     return router, runtimes
+
+
+def rolling_upgrade(router: FleetRouter, runtimes: List[Any],
+                    checkpoint_shards, src_layout, *,
+                    generation: int, head_dim: int,
+                    model_id: Optional[str] = None,
+                    worker_kwargs: Optional[Dict[str, Any]] = None,
+                    beat_interval_s: Optional[float] = None,
+                    timeout_s: float = 60.0) -> Dict[str, Any]:
+    """Install a new checkpoint generation across a LIVE fleet with
+    zero restart and zero shed (ISSUE 18 tentpole b).
+
+    The checkpoint arrives as its saved host shards; ``reshard_host``
+    (the portable-redistribution primitive, arxiv 2112.01075 / PR 8)
+    re-partitions them to each worker's layout with the documented
+    exactness contract — so the installed weights are bit-identical to
+    the checkpoint however it was sharded, and a pinned greedy request
+    decodes token-exactly across the upgrade when the values match.
+
+    Per target engine worker (oldest generation first, one at a time):
+
+    1. spawn the replacement with the NEW params and
+       ``weights_generation=generation`` under a FRESH name (mailbox
+       cursors die with the old incarnation — the rolling-restart
+       rule) and admit it via :meth:`FleetRouter.add_worker`;
+    2. wait until its lease makes it ``live`` — capacity never dips,
+       which is what makes the shed-free guarantee structural rather
+       than lucky;
+    3. ``drain`` the old worker and wait for the drained handshake
+       (in-flight work finishes on the old weights; nothing is shed —
+       the PR 10/11 drain discipline).
+
+    In-process fleets only (``runtimes`` of
+    :class:`~chainermn_tpu.serving.worker.WorkerRuntime`): each
+    replacement runs on a daemon thread and is appended to
+    ``runtimes``.  Safe with a started router thread (the same
+    concurrent-``step`` contract as :meth:`FleetRouter.wait_drained`).
+    Returns ``{generation, upgraded: [{old, new}...], drain_shed,
+    rejected_delta}`` — the acceptance gates ``drain_shed == 0``.
+    """
+    import threading as _threading
+
+    from ..parallel.reshard import reshard_host
+    from .worker import WorkerRuntime
+
+    new_params = reshard_host(list(checkpoint_shards), src_layout,
+                              None, 1)[0]
+    targets = [w for w in router.workers.values()
+               if w.role == "engine" and w.state in ("starting", "live")
+               and (model_id is None or w.model_id == model_id)
+               and w.weights_generation < int(generation)]
+    if not targets:
+        raise ValueError(
+            f"rolling_upgrade: no live engine worker below generation "
+            f"{generation}"
+            + (f" for model {model_id!r}" if model_id else ""))
+    targets.sort(key=lambda w: (w.weights_generation, w.name))
+    m0 = router.metrics()
+    upgraded = []
+    for old in targets:
+        new_name = f"{old.name}.g{int(generation)}"
+        rt = WorkerRuntime(
+            new_name, old.role, new_params, router.store,
+            head_dim=int(head_dim), epoch=1,
+            beat_interval_s=(router.beat_interval_s
+                             if beat_interval_s is None
+                             else float(beat_interval_s)),
+            model_id=old.model_id,
+            weights_generation=int(generation),
+            **(worker_kwargs or {}))
+        _threading.Thread(target=rt.run, daemon=True,
+                          name=f"upgrade-{new_name}").start()
+        runtimes.append(rt)
+        router.add_worker(WorkerClient(new_name, old.role, router.store,
+                                       epoch=1, lane_config=router.lane_config,
+                                       model_id=old.model_id))
+        deadline = time.monotonic() + float(timeout_s)
+        while router.workers[new_name].state != "live":
+            router.step()
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"rolling_upgrade: replacement {new_name} not live "
+                    f"within {timeout_s}s")
+            time.sleep(0.005)
+        router.drain(old.name)
+        if not router.wait_drained(old.name, timeout_s=timeout_s):
+            raise TimeoutError(
+                f"rolling_upgrade: {old.name} not drained within "
+                f"{timeout_s}s")
+        upgraded.append({"old": old.name, "new": new_name})
+        _flight.note("fleet", event="weights_upgraded", old=old.name,
+                     new=new_name, generation=int(generation))
+    m1 = router.metrics()
+    return {
+        "generation": int(generation),
+        "upgraded": upgraded,
+        "drain_shed": int(m1.get("fleet/shed_inflight_total", 0)
+                          - m0.get("fleet/shed_inflight_total", 0)),
+        "rejected_delta": int(m1.get("fleet/rejected_total", 0)
+                              - m0.get("fleet/rejected_total", 0)),
+    }

@@ -50,10 +50,13 @@ TIERS = ("hot", "spill")
 class IndexRecord:
     """One worker's claim: ``seq[:length]``'s K/V is pullable from
     ``worker`` (announced under ``epoch``, with the slab ``geom`` the
-    router needs to price the transfer)."""
+    router needs to price the transfer).  ``model_id`` rides the geom
+    (ISSUE 18): in a heterogeneous fleet a claim is only pullable into
+    a worker serving the SAME variant — K/V from a different model is
+    geometry-compatible garbage at best."""
 
     __slots__ = ("worker", "seq", "length", "epoch", "geom", "tier",
-                 "last_used")
+                 "model_id", "last_used")
 
     def __init__(self, worker: str, seq: Tuple[int, ...], length: int,
                  epoch: int, geom: Optional[Dict[str, Any]],
@@ -66,11 +69,13 @@ class IndexRecord:
         self.epoch = int(epoch)
         self.geom = dict(geom) if geom else None
         self.tier = tier
+        self.model_id = (self.geom or {}).get("model_id")
         self.last_used = 0
 
     def __repr__(self):
         return (f"IndexRecord({self.worker!r}, len={self.length}, "
-                f"epoch={self.epoch}, tier={self.tier})")
+                f"epoch={self.epoch}, tier={self.tier}, "
+                f"model={self.model_id})")
 
 
 class _Node:
@@ -271,16 +276,23 @@ class FleetCacheIndex:
                     break
             node = parent
 
-    def _subtree_best(self, node: "_Node", workers=None
+    def _subtree_best(self, node: "_Node", workers=None,
+                      model_id: Optional[str] = None
                       ) -> Optional[IndexRecord]:
         """Best record in the subtree: hot beats spill, recent beats
-        old (record count is bounded by slots × workers — cheap DFS)."""
+        old (record count is bounded by slots × workers — cheap DFS).
+        ``model_id`` pins the variant: an unlabeled record (no geom)
+        is REFUSED under a pinned query — conservative, because a
+        cross-model pull is silent garbage, a re-prefill is just
+        tokens."""
         best: Optional[IndexRecord] = None
         stack = [node]
         while stack:
             n = stack.pop()
             for rec in n.recs.values():
                 if workers is not None and rec.worker not in workers:
+                    continue
+                if model_id is not None and rec.model_id != model_id:
                     continue
                 if best is None or (
                         (TIERS.index(rec.tier), -rec.last_used)
@@ -292,13 +304,17 @@ class FleetCacheIndex:
     # ------------------------------------------------------------------
     # lookup
     # ------------------------------------------------------------------
-    def match(self, prompt, workers=None, count: bool = True
+    def match(self, prompt, workers=None, count: bool = True,
+              model_id: Optional[str] = None
               ) -> Tuple[Optional[IndexRecord], int]:
         """Longest indexed prefix of ``prompt`` among ``workers`` (None
         = any): ``(record, match_len)`` with the trie-cache semantics —
         capped at ``len(prompt) - 1`` and the record's own length — or
         ``(None, 0)``.  ``count=False`` is the peek face (per-worker
-        probes must not distort the hit/miss counters)."""
+        probes must not distort the hit/miss counters).  ``model_id``
+        keys the claim (ISSUE 18): only same-variant records match; a
+        prefix that WOULD have hit another variant's slab is a counted
+        ``model_mismatch`` stale fallback, never a cross-model pull."""
         prompt = tuple(int(t) for t in prompt)
         if len(prompt) < 2:
             if count:
@@ -307,8 +323,16 @@ class FleetCacheIndex:
             return None, 0
         with self._lock:
             node, depth, partial = self._walk(prompt[: len(prompt) - 1])
-            rec = self._subtree_best(
-                partial if partial is not None else node, workers)
+            sub = partial if partial is not None else node
+            rec = self._subtree_best(sub, workers, model_id)
+            if rec is None and model_id is not None \
+                    and depth >= self.min_prefix_len and count \
+                    and self._subtree_best(sub, workers) is not None:
+                # the ONLY claims on this prefix belong to a different
+                # variant — the heterogeneous-fleet near-miss, counted
+                # under the existing stale-fallback discipline
+                self.stale_fallbacks["model_mismatch"] = \
+                    self.stale_fallbacks.get("model_mismatch", 0) + 1
             if rec is None or depth < self.min_prefix_len:
                 if count:
                     self.misses += 1
@@ -378,7 +402,7 @@ class FleetCacheIndex:
                                for v in self._by_worker.values()),
                 "per_worker": {
                     w: [{"len": rec.length, "tier": rec.tier,
-                         "epoch": rec.epoch,
+                         "epoch": rec.epoch, "model": rec.model_id,
                          "seq_head": list(rec.seq[:8])}
                         for rec in sorted(v.values(),
                                           key=lambda r: -r.last_used)]

@@ -109,11 +109,18 @@ class WorkerRuntime:
                  max_prefills_per_tick: int = 1, prefill_bucket: int = 1,
                  mesh=None, axis_name: str = "model",
                  prefix_cache: bool = True,
-                 spill_bytes: int = 32 << 20):
+                 spill_bytes: int = 32 << 20,
+                 model_id: str = "default",
+                 weights_generation: int = 1):
         if role not in ROLES:
             raise ValueError(f"role must be one of {ROLES}, got {role!r}")
         self.name = str(name)
         self.role = str(role)
+        # heterogeneous-fleet identity (ISSUE 18): which model variant
+        # this worker serves and which weight generation it holds; both
+        # ride every lease so the router routes/upgrades per-model
+        self.model_id = str(model_id)
+        self.weights_generation = int(weights_generation)
         self.store = store
         self.epoch = int(epoch)
         self.lane_config = lane_config
@@ -194,7 +201,8 @@ class WorkerRuntime:
         worker's prefixes in token units (transfer_cost statics)."""
         pool = self.engine.pool
         return {"n_layers": pool.n_layers, "kv_dim": pool.kv_dim,
-                "dtype": str(pool.caches[0][0].dtype)}
+                "dtype": str(pool.caches[0][0].dtype),
+                "model_id": self.model_id}
 
     def _announce_insert(self, entry) -> None:
         try:
@@ -268,7 +276,8 @@ class WorkerRuntime:
             # marks the instant the new epoch takes effect worker-side —
             # the conformance monitor's worker.process_hello action
             _journal.emit("hello_processed", worker=self.name,
-                          epoch=self.epoch)
+                          epoch=self.epoch, model_id=self.model_id,
+                          weights_generation=self.weights_generation)
             self.heart.beat(**self._lease_state())
             # full cache-index rebuild rides the handshake (ISSUE 12):
             # the router dropped every fenced-epoch entry at death,
@@ -579,6 +588,8 @@ class WorkerRuntime:
                 "backlog_tokens": sum(r.prompt_len for r in queued),
                 "draining": self.draining,
                 "last_step_age_s": round(step_age, 4),
+                "model_id": self.model_id,
+                "weights_generation": self.weights_generation,
                 "cache": {"prefill_calls":
                           int(self.dec_engine.prefill_calls)},
             }
@@ -616,6 +627,11 @@ class WorkerRuntime:
             "tokens_emitted": eng._tokens_emitted,
             "in_flight": len(self._local),
             "draining": self.draining,
+            "model_id": self.model_id,
+            "weights_generation": self.weights_generation,
+            # destination-side slab geometry (ISSUE 18): the router's
+            # pull planner refuses geometry-mismatched claims against it
+            "geom": self._geom(),
             "last_step_age_s": round(step_age, 4),
             "tick_gap_p99_ms": (None if gap_p99 is None
                                 else round(gap_p99, 3)),

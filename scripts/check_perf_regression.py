@@ -106,7 +106,7 @@ _LOWER = re.compile(
     r"rejected|shed|steps_to_recover|variance|requeue|detection|"
     r"failover|fenced|redispatch|flap|ttft|rung|degraded|"
     r"prefill_calls|stale|spill|crc|reconfig|consensus|steps_lost|"
-    r"overhead|violation)",
+    r"overhead|violation|slo_burn)",
     re.IGNORECASE)
 
 

@@ -1,0 +1,288 @@
+"""Scenario-plane tests (ISSUE 18), fast tier — jax-free throughout.
+
+Three layers:
+
+* **Determinism fuzz** over every generator family: same seed ⇒
+  byte-identical event stream (digest equality across two independent
+  runs), different seed ⇒ different stream, every record
+  schema-checked, composed-chaos interleaves stably.
+* **Prompt materialization**: spec → tokens is a pure function of the
+  spec (prefix groups share their prefix EXACTLY; tails differ).
+* **Replay driver** against a fake router: events submit in order with
+  tenant/priority/deadline riding, faults land on the right worker,
+  sheds are counted, and the matrix row carries the gated keys.
+
+The rolling-upgrade unit at the ``reshard_host`` layer (old→new
+generation layout, per-worker exactness) lives here too — it is the
+weight-install half of the scenario plane's upgrade story and needs no
+devices.
+"""
+
+import numpy as np
+import pytest
+
+from chainermn_tpu.serving import scenarios as sc
+
+GENERATORS = {
+    "staggered": lambda seed: sc.staggered(12, 0.01, seed=seed,
+                                           tenant="t", deadline_s=1.0),
+    "diurnal": lambda seed: sc.diurnal(seed, jitter_frac=0.3),
+    "flash_crowd": sc.flash_crowd,
+    "adversarial": sc.adversarial,
+    "mixed_deadlines": sc.mixed_deadlines,
+    "composed_chaos": sc.composed_chaos,
+}
+
+
+# ---------------------------------------------------------------------------
+# determinism fuzz
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("family", sorted(GENERATORS))
+@pytest.mark.parametrize("seed", [0, 1, 7, 1234])
+def test_same_seed_byte_identical(family, seed):
+    a = GENERATORS[family](seed)
+    b = GENERATORS[family](seed)
+    assert [sc.canonical_bytes(e) for e in a] \
+        == [sc.canonical_bytes(e) for e in b]
+    assert sc.stream_digest(a) == sc.stream_digest(b)
+
+
+@pytest.mark.parametrize("family", sorted(GENERATORS))
+def test_different_seed_different_stream(family):
+    digests = {sc.stream_digest(GENERATORS[family](s))
+               for s in range(6)}
+    assert len(digests) == 6, f"{family} ignores its seed"
+
+
+@pytest.mark.parametrize("family", sorted(GENERATORS))
+@pytest.mark.parametrize("seed", range(5))
+def test_streams_schema_checked(family, seed):
+    events = GENERATORS[family](seed)
+    assert sc.check_stream(events) == len(events) > 0
+    assert all(e["schema"] == sc.SCENARIO_SCHEMA for e in events)
+
+
+def test_composed_chaos_interleaves_stably():
+    events = sc.composed_chaos(5)
+    kinds = [e["kind"] for e in events]
+    assert "fault" in kinds and "request" in kinds
+    actions = [e["fault"]["action"] for e in events
+               if e["kind"] == "fault"]
+    assert actions == ["kill", "pause", "resume"]
+    # merge is stable under re-merge: splitting by kind and merging
+    # back reproduces the same interleave byte-for-byte
+    reqs = sc.finalize([e for e in events if e["kind"] == "request"])
+    faults = sc.finalize([e for e in events if e["kind"] == "fault"])
+    assert sc.stream_digest(sc.merge(reqs, faults)) \
+        == sc.stream_digest(events)
+
+
+def test_merge_ties_keep_stream_order():
+    a = sc.staggered(3, 0.0, seed=1, tenant="a")
+    b = sc.staggered(3, 0.0, seed=2, tenant="b")
+    merged = sc.merge(a, b)
+    assert [e["tenant"] for e in merged] == ["a"] * 3 + ["b"] * 3
+    assert [e["seq"] for e in merged] == list(range(6))
+
+
+def test_validate_event_refuses_garbage():
+    ok = sc.request_event(0.0, tenant="t")
+    sc.validate_event(dict(ok, seq=0))
+    with pytest.raises(ValueError, match="schema"):
+        sc.validate_event(dict(ok, schema="other.v9"))
+    with pytest.raises(ValueError, match="kind"):
+        sc.validate_event(dict(ok, kind="weird"))
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        sc.validate_event(dict(ok, max_new_tokens=0))
+    with pytest.raises(ValueError, match="deadline"):
+        sc.validate_event(dict(ok, deadline_s=-1.0))
+    with pytest.raises(ValueError, match="action"):
+        sc.fault_event(0.0, "unplug", 0)
+    with pytest.raises(ValueError, match="non-decreasing"):
+        sc.check_stream([dict(sc.request_event(1.0), seq=0),
+                         dict(sc.request_event(0.5), seq=1)])
+
+
+def test_registry_builders():
+    assert set(sc.SCENARIOS) == {"diurnal", "flash_crowd",
+                                 "adversarial", "mixed_deadlines",
+                                 "composed_chaos"}
+    with pytest.raises(ValueError, match="unknown scenario"):
+        sc.build_scenario("rush_hour")
+    a = sc.build_scenario("flash_crowd", seed=2)
+    assert sc.stream_digest(a) == sc.stream_digest(sc.flash_crowd(2))
+
+
+# ---------------------------------------------------------------------------
+# prompt materialization
+# ---------------------------------------------------------------------------
+
+def test_materialize_prompt_deterministic():
+    spec = {"seed": 77, "len": 16, "prefix_group": "g", "prefix_len": 6}
+    a = sc.materialize_prompt(spec, 32)
+    b = sc.materialize_prompt(spec, 32)
+    assert a == b and len(a) == 16
+    assert all(0 <= t < 32 for t in a)
+
+
+def test_prefix_groups_share_prefix_exactly():
+    ev = sc.staggered(4, 0.0, seed=9, prefix_group="crowd",
+                      prefix_len=8, prompt_len=12)
+    prompts = [sc.materialize_prompt(e["prompt"], 64) for e in ev]
+    assert len({tuple(p[:8]) for p in prompts}) == 1   # shared prefix
+    assert len({tuple(p) for p in prompts}) == 4       # distinct tails
+    other = sc.materialize_prompt(
+        {"seed": 0, "len": 12, "prefix_group": "other", "prefix_len": 8},
+        64)
+    assert other[:8] != prompts[0][:8]
+
+
+def test_adversarial_sniper_shares_paid_prefix():
+    events = sc.adversarial(3)
+    by_tenant = {}
+    for e in events:
+        if e["kind"] == "request":
+            by_tenant.setdefault(e["tenant"], []).append(e)
+    gold = sc.materialize_prompt(by_tenant["gold"][0]["prompt"], 32)
+    snipe = sc.materialize_prompt(by_tenant["sniper"][0]["prompt"], 32)
+    plen = by_tenant["gold"][0]["prompt"]["prefix_len"]
+    assert plen >= 2 and gold[:plen] == snipe[:plen]
+    assert all(e["priority"] == "paid" for e in by_tenant["gold"])
+    assert all(e["priority"] == "best_effort"
+               for e in by_tenant["sniper"] + by_tenant["hog"])
+
+
+# ---------------------------------------------------------------------------
+# replay driver (fake fleet — no jax, no threads)
+# ---------------------------------------------------------------------------
+
+class _FakeHandle:
+    def __init__(self, tokens):
+        self.tokens = list(tokens)
+        self.status = "done"
+        self.finish_reason = "eos"
+
+
+class _FakeWorker:
+    def __init__(self):
+        self.state = "live"
+
+
+class _FakeRouter:
+    """Just enough surface for run_scenario: records every submit,
+    sheds the tenant named 'shed-me', exposes fleet metrics."""
+
+    def __init__(self):
+        self.workers = {"engine0": _FakeWorker(), "engine1": _FakeWorker()}
+        self.submits = []
+        self.autoscaler = None
+        self.tenancy = None
+
+    def submit(self, prompt, max_new_tokens, **kw):
+        from chainermn_tpu.serving.scheduler import AdmissionError
+        if kw.get("tenant") == "shed-me":
+            raise AdmissionError("queue_full", "no", retry_after_ms=0.1)
+        self.submits.append((list(prompt), max_new_tokens, kw))
+        return _FakeHandle([1] * max_new_tokens)
+
+    def metrics(self):
+        return {"fleet/shed_rate": 0.25, "fleet/shed_inflight_total": 0,
+                "fleet/dead_workers": 0}
+
+
+class _FakeRuntime:
+    def __init__(self):
+        self.killed = False
+        self.kills = 0
+
+    def kill(self):
+        self.killed = True
+        self.kills += 1
+
+
+def test_run_scenario_replays_requests_and_faults():
+    router = _FakeRouter()
+    runtimes = [_FakeRuntime(), _FakeRuntime()]
+    events = sc.merge(
+        sc.staggered(4, 0.0, seed=0, tenant="ok", deadline_s=5.0),
+        sc.staggered(2, 0.0, seed=1, tenant="shed-me", deadline_s=5.0),
+        sc.finalize([sc.fault_event(0.0, "kill", 0),
+                     sc.fault_event(0.0, "pause", 1),
+                     sc.fault_event(0.0, "resume", 1)]))
+    out = sc.run_scenario(events, router, vocab=32, time_scale=0.0,
+                          runtimes=runtimes, max_attempts=1,
+                          settle_timeout_s=1.0, sleep=lambda s: None)
+    assert len(router.submits) == 4
+    # tenant/deadline rode the submit kwargs
+    assert all(kw["tenant"] == "ok" and kw["deadline_s"] == 5.0
+               for _, _, kw in router.submits)
+    assert runtimes[0].kills == 1
+    assert runtimes[1].killed is False        # paused then resumed
+    assert out["n_requests"] == 6 and out["n_faults"] == 3
+    assert out["offered_shed"] == 2
+    assert out["shed_by_tenant"] == {"shed-me": 2}
+    assert out["shed_rate"] == 0.25           # straight off metrics()
+    # 2 of 6 deadline-carrying requests shed before a handle existed
+    assert out["slo_burn"] == round(2 / 6, 4)
+    assert out["terminal_frac"] == 1.0
+    assert out["digest"] == sc.stream_digest(events)
+    assert out["peak_workers"] == 2
+
+
+def test_run_scenario_refuses_unchecked_stream():
+    router = _FakeRouter()
+    bad = [sc.request_event(0.0)]             # no seq / not finalized
+    with pytest.raises(ValueError, match="seq"):
+        sc.run_scenario(bad, router, vocab=32, time_scale=0.0,
+                        sleep=lambda s: None)
+
+
+# ---------------------------------------------------------------------------
+# rolling upgrade at the reshard_host layer (satellite 3)
+# ---------------------------------------------------------------------------
+
+def _ckpt(seed, vocab=8, d=4):
+    rng = np.random.RandomState(seed)
+    return {"embed": rng.randn(vocab, d).astype(np.float32),
+            "blocks": [{"w": rng.randn(d, d).astype(np.float32)}],
+            "step": np.int64(7)}
+
+
+def test_upgrade_reshard_old_to_new_generation_exact():
+    from chainermn_tpu.parallel.reshard import reshard_host
+
+    full = _ckpt(0)
+    layout = {"embed": 0, "blocks": [{"w": None}], "step": None}
+    # the checkpoint was SAVED by a 2-process world, embed row-sharded
+    shards = [
+        {"embed": np.split(full["embed"], 2, axis=0)[i],
+         "blocks": [{"w": full["blocks"][0]["w"]}],
+         "step": full["step"]}
+        for i in range(2)]
+    # install on ONE worker (the rolling-upgrade path): replicated
+    merged = reshard_host(shards, layout, None, 1)[0]
+    np.testing.assert_array_equal(merged["embed"], full["embed"])
+    np.testing.assert_array_equal(merged["blocks"][0]["w"],
+                                  full["blocks"][0]["w"])
+    assert merged["step"] == full["step"]
+    # install on a NEW 4-worker generation layout: per-worker exactness
+    new = reshard_host(shards, layout, layout, 4)
+    assert len(new) == 4
+    np.testing.assert_array_equal(
+        np.concatenate([s["embed"] for s in new], axis=0),
+        full["embed"])
+    for s in new:
+        np.testing.assert_array_equal(s["blocks"][0]["w"],
+                                      full["blocks"][0]["w"])
+
+
+def test_upgrade_reshard_refuses_uneven_split():
+    from chainermn_tpu.parallel.reshard import reshard_host
+
+    full = _ckpt(1, vocab=9)                  # 9 rows don't split by 2
+    with pytest.raises(ValueError, match="divide evenly"):
+        reshard_host([full], {"embed": None, "blocks": [{"w": None}],
+                              "step": None},
+                     {"embed": 0, "blocks": [{"w": None}],
+                      "step": None}, 2)
