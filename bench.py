@@ -1610,6 +1610,12 @@ def bench_serving_scenarios():
       tenant) while best-effort absorbs the ladder.
     * ``composed_chaos`` — worker kill + flash crowd + SIGSTOP zombie
       in ONE run, on a 2-worker fleet.
+    * ``hetero_skew`` — the flash-crowd stream against a size-skewed
+      variant PAIR (d32 big + d16 small, ISSUE 19 satellite) behind
+      one router, plus pinned probes: per-variant determinism
+      (``pin_parity_violations`` == 0), cross-variant divergence
+      (``variant_distinct`` == 1), unknown-model shed
+      (``unknown_model_refused`` == 1).
 
     Then the upgrade: a checkpoint-v2 generation (saved SHARDED,
     installed through ``reshard_host``) rolls across a live 2-worker
@@ -1675,7 +1681,8 @@ def bench_serving_scenarios():
     conformance_violations = 0
     conformance_checked = 0
 
-    def run_one(name, *, n_workers=1, tenants=(), faults=False):
+    def run_one(name, *, n_workers=1, tenants=(), faults=False,
+                topology=None, registry=None, jname=None, probe=None):
         nonlocal conformance_violations, conformance_checked
         tenancy = None
         if tenants:
@@ -1683,36 +1690,44 @@ def bench_serving_scenarios():
             for tname, cls, cap in tenants:
                 budgets = {} if cap is None else {"max_inflight": cap}
                 tenancy.register(tname, cls, **budgets)
-        jdir = os.path.join(jroot, name)
+        jdir = os.path.join(jroot, jname or name)
         _journal.configure(jdir, "bench")
         router, runtimes = build_local_fleet(
-            params, {"engine": n_workers}, head_dim=d_model // n_heads,
+            params, topology or {"engine": n_workers},
+            head_dim=d_model // n_heads,
             # wide lease window: in-process prefill compiles stall the
             # GIL for seconds and the scenarios measure workload
             # response, not detection latency (composed_chaos's kill
             # still detects — its settle window dwarfs 0.85 s)
             beat_interval_s=0.05, miss_beats=16, worker_kwargs=wk,
-            tenancy=tenancy)
+            tenancy=tenancy, registry=registry)
         threads = [threading.Thread(target=rt.run, daemon=True)
                    for rt in runtimes]
         for t in threads:
             t.start()
         router.start()
         try:
-            # warm every prompt-length compile outside the window
+            # warm every prompt-length compile outside the window —
+            # pinned per variant on a heterogeneous fleet (each model
+            # compiles its own prefill programs)
+            pins = registry.ids() if registry is not None else [None]
             for plen in sorted({ev["prompt"]["len"]
                                 for ev in streams[name]
                                 if ev["kind"] == "request"}):
-                h = router.submit(np.zeros(plen, np.int32), 2)
-                t0 = time.time()
-                while (h.status not in ("done", "evicted")
-                       and time.time() - t0 < 30):
-                    time.sleep(0.005)
+                for mid in pins:
+                    h = router.submit(np.zeros(plen, np.int32), 2,
+                                      model_id=mid)
+                    t0 = time.time()
+                    while (h.status not in ("done", "evicted")
+                           and time.time() - t0 < 30):
+                        time.sleep(0.005)
             router.reset_stats()
             out = _sc.run_scenario(
                 streams[name], router, vocab=vocab,
                 runtimes=runtimes if faults else (),
                 tenancy=tenancy, max_attempts=2, settle_timeout_s=60.0)
+            if probe is not None:
+                out.update(probe(router))
         finally:
             router.stop()
             for rt in runtimes:
@@ -1742,6 +1757,61 @@ def bench_serving_scenarios():
                      ("hog", "best_effort", 2)))
         result["composed_chaos"] = run_one("composed_chaos",
                                            n_workers=2, faults=True)
+
+        # --- size-skewed variant pair on ONE fleet (ISSUE 19) ---------
+        # A d32 "big" and a d16 "small" variant behind one router: the
+        # flash-crowd burst routes unpinned across both (the token-unit
+        # least-loaded order exists for exactly this skew), then pinned
+        # probes assert variant isolation — greedy decodes are
+        # deterministic per variant and the two weight sets must
+        # disagree on the same prompt.
+        from chainermn_tpu.serving.models import (ModelRegistry,
+                                                  ModelVariant)
+        from chainermn_tpu.serving.scheduler import AdmissionError
+        params_small = init_tp_transformer_lm(
+            jax.random.PRNGKey(1), vocab, 16, 2, 1, max_len=64,
+            pos_impl="rope")
+        registry = ModelRegistry()
+        registry.register(ModelVariant(
+            "lm-big", params, head_dim=d_model // n_heads))
+        # the size skew is real capacity: the small variant affords
+        # twice the decode slots on the same footprint
+        registry.register(ModelVariant(
+            "lm-small", params_small, head_dim=8,
+            worker_kwargs=dict(n_slots=8)))
+        hetero_prompt = np.arange(s_p, dtype=np.int32) % vocab
+
+        def hetero_probe(router):
+            def pinned(mid):
+                h = router.submit(hetero_prompt, new, model_id=mid)
+                t0 = time.time()
+                while (h.status not in ("done", "evicted")
+                       and time.time() - t0 < 30):
+                    time.sleep(0.005)
+                return list(h.tokens)
+
+            big, small = pinned("lm-big"), pinned("lm-small")
+            try:
+                router.submit(hetero_prompt, new, model_id="lm-ghost")
+                ghost_refused = 0
+            except AdmissionError:
+                ghost_refused = 1
+            return {
+                "variants": 2,
+                # pinned greedy decode is deterministic per variant
+                "pin_parity_violations": (int(big != pinned("lm-big"))
+                                          + int(small
+                                                != pinned("lm-small"))),
+                # different weights must disagree (bound: 1)
+                "variant_distinct": int(big != small),
+                # an unregistered model_id must shed, not misroute
+                "unknown_model_refused": ghost_refused,
+            }
+
+        result["hetero_skew"] = run_one(
+            "flash_crowd", topology={"engine": ["lm-big", "lm-small"]},
+            registry=registry, jname="hetero_skew",
+            probe=hetero_probe)
 
         # --- rolling weight upgrade on a live 2-worker fleet ----------
         jdir = os.path.join(jroot, "rolling_upgrade")
@@ -1825,6 +1895,89 @@ def bench_serving_scenarios():
         "repro_violations": repro_violations,
         "conformance_violations": conformance_violations,
         "conformance_checked": conformance_checked,
+    })
+    return result
+
+
+def bench_collective_schedules():
+    """Collective schedule compile plane (ISSUE 19, docs/ANALYSIS.md
+    "Schedule verifier"): every fleet-reachable reshard spec pair is
+    lowered to candidate comm programs (single / chunked / pipelined /
+    hierarchically staged), every candidate passes the FULL static
+    verifier (byte coverage vs the array_split statics, exhaustive BFS
+    of the start/done machine, interpreter byte-exactness), and the
+    cheapest verified candidate under the r04 cost model is chosen.
+
+    Host-only (stdlib + numpy; no device work) — every-backend
+    contract.  Gated keys: per-pair ``speedup_vs_single`` and the
+    headline ``hier_speedup`` higher-is-better (acceptance bound: the
+    hierarchical candidate beats the single-collective baseline on the
+    ICI+DCN fan-out pair, > 1.0); ``*_cost_ms``/``*_bytes``/
+    ``*_violations`` lower-is-better (both violation counters bound at
+    0); ``faults_caught``/``verified_pairs`` higher-is-better (the
+    seeded-fault corpus: every expressible mutation caught — 0 false
+    negatives — on schedules whose clean forms all verify).
+    """
+    from chainermn_tpu.analysis import schedule as S
+    from chainermn_tpu.analysis import schedule_check as SC
+
+    shape, dtype = (24, 4), "float32"
+    result = {}
+    schedule_violations = 0
+    hier_speedup = None
+    for name, src, dst, sw, dw in SC.FLEET_PAIRS:
+        topo = SC.fleet_pair_topology(sw, dw)
+        try:
+            sched, report = SC.compile_verified(
+                shape, dtype, src, dst, sw, dw, topo)
+        except RuntimeError as e:
+            schedule_violations += 1
+            print(f"bench: schedule pair {name} failed verification: "
+                  f"{e}", file=sys.stderr)
+            continue
+        result[name] = {
+            "chosen": report["kind"],
+            "best_cost_ms": report["cost_ms"],
+            "single_cost_ms": report["baseline_cost_ms"],
+            "speedup_vs_single": round(report["speedup_vs_single"], 4),
+            "ici_bytes": report["ici_bytes"],
+            "dcn_bytes": report["dcn_bytes"],
+        }
+        if name == "rolling_upgrade_fanout":
+            hier_speedup = report["speedup_vs_single"]
+
+    # seeded-fault corpus: each mutator class on a hierarchical and a
+    # flat chunked schedule — the verifier must catch every expressible
+    # fault (0 false negatives) and pass both clean forms (0 false
+    # positives, enforced above by compile_verified raising)
+    faults_checked = faults_caught = fault_miss_violations = 0
+    topo = S.Topology(2, 2)
+    for sched in (
+            S.lower_hierarchical(shape, dtype, 0, None, 4, 4, topo,
+                                 n_chunks=2),
+            S.lower_chunked(shape, dtype, 0, None, 4, 4, topo,
+                            n_chunks=2)):
+        for fault in SC.SEEDED_FAULTS:
+            try:
+                bad = SC.seed_fault(sched, fault)
+            except ValueError:
+                continue  # fault class not expressible on this shape
+            faults_checked += 1
+            if SC.verify_schedule(bad).ok:
+                fault_miss_violations += 1
+            else:
+                faults_caught += 1
+
+    result.update({
+        "config": f"shape {shape} {dtype}, chunks 2 depth 2, r04 cost "
+                  f"model, {len(SC.FLEET_PAIRS)} fleet pairs",
+        "verified_pairs": len(SC.FLEET_PAIRS) - schedule_violations,
+        "schedule_violations": schedule_violations,
+        "hier_speedup": (round(hier_speedup, 4)
+                         if hier_speedup is not None else None),
+        "faults_checked": faults_checked,
+        "faults_caught": faults_caught,
+        "fault_miss_violations": fault_miss_violations,
     })
     return result
 
@@ -2957,6 +3110,7 @@ def main():
         "serving_autoscale": None,
         "serving_kv_economy": None,
         "serving_scenarios": None,
+        "collective_schedules": None,
         "train_chaos": None,
         "data_path": None,
         "long_context": None,
@@ -3028,6 +3182,8 @@ def main():
             "scenario_upgrade_drain_shed": g(
                 result, "serving_scenarios", "rolling_upgrade",
                 "drain_shed"),
+            "schedules_hier_speedup": g(result, "collective_schedules",
+                                        "hier_speedup"),
             "train_chaos_detection_ms": g(result, "train_chaos",
                                           "detection_ms"),
             "train_chaos_reconfig_ms": g(result, "train_chaos",
@@ -3262,6 +3418,24 @@ def main():
             emit()
     else:
         print("bench: over budget — serving_scenarios section skipped",
+              file=sys.stderr)
+
+    # --- collective schedules: compiled, verified comm programs (ISSUE 19) -
+    # Host-only (stdlib + numpy); every-backend contract.  hier_speedup/
+    # speedup_vs_single/verified_pairs/faults_caught gate higher-is-better,
+    # *_cost_ms/*_bytes/*_violations lower-is-better — the acceptance
+    # bounds are hier_speedup > 1.0 on the ICI+DCN fan-out pair and both
+    # violation counters == 0.
+    if not over_budget():
+        try:
+            result["collective_schedules"] = bench_collective_schedules()
+            emit("collective_schedules")
+        except Exception as e:
+            print(f"bench: collective_schedules section failed: {e!r}",
+                  file=sys.stderr)
+            emit()
+    else:
+        print("bench: over budget — collective_schedules section skipped",
               file=sys.stderr)
 
     # --- train chaos: rank death -> live shrink cost (ISSUE 13) ------------

@@ -64,20 +64,36 @@ from .shardflow import (  # noqa: F401  (stdlib-only at import time)
 )
 from .concurrency import CONCURRENCY_RULES  # noqa: F401  (stdlib-only)
 from .protocol import ALL_MODELS as PROTOCOL_MODELS  # noqa: F401
+from .schedule import (  # noqa: F401  (stdlib+numpy only)
+    GENERATORS as SCHEDULE_GENERATORS,
+    Schedule,
+    Topology,
+)
+from .schedule_check import (  # noqa: F401
+    FLEET_PAIRS,
+    SEEDED_FAULTS,
+    verify_schedule,
+)
 
 __all__ = [
     "AST_RULES",
     "Baseline",
     "CONCURRENCY_RULES",
     "CollectiveRegistry",
+    "FLEET_PAIRS",
     "Finding",
     "PROTOCOL_MODELS",
+    "SCHEDULE_GENERATORS",
+    "SEEDED_FAULTS",
     "SEVERITIES",
     "SHARDFLOW_RULES",
+    "Schedule",
     "ShardflowReport",
+    "Topology",
     "analyze_file",
     "analyze_paths",
     "analyze_source",
     "default_registry",
     "load_baseline",
+    "verify_schedule",
 ]

@@ -51,7 +51,10 @@ def build_parser() -> argparse.ArgumentParser:
         prog="python -m chainermn_tpu.analysis",
         description="SPMD-aware static analyzer: collective-deadlock, "
                     "PRNG, host-aliasing, and recompilation lint for "
-                    "JAX code (docs/ANALYSIS.md)")
+                    "JAX code (docs/ANALYSIS.md).  With --gate, runs "
+                    "EVERY analysis plane (lint + protocol models + "
+                    "shardflow + schedule verifier) as one CI check "
+                    "(see --gate --help)")
     p.add_argument("paths", nargs="*", default=None,
                    help="files/directories to scan (default: the "
                         "chainermn_tpu package directory)")
@@ -85,7 +88,82 @@ def _package_dir() -> str:
     return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+#: the ``--gate`` stages, in run order: each is (name, thunk returning
+#: an exit code under the same 0/1/2 contract).
+GATE_STAGES = ("lint", "protocol", "shardflow", "schedules")
+
+
+def gate_main(argv: Optional[List[str]] = None) -> int:
+    """``python -m chainermn_tpu.analysis --gate`` — ONE CI-callable
+    check running every analysis plane: the SPMD+concurrency lint, the
+    protocol model checker, the shardflow statics reconciliation, and
+    the collective schedule verifier.  Exit is the worst stage under
+    the shared contract: 0 clean, 1 findings/violations, 2 unusable.
+    """
+    p = argparse.ArgumentParser(
+        prog="python -m chainermn_tpu.analysis --gate",
+        description="run all analysis gates "
+                    f"({', '.join(GATE_STAGES)}) and exit 0/1/2")
+    p.add_argument("--stages", default=",".join(GATE_STAGES),
+                   help="comma-separated stage subset, in run order")
+    p.add_argument("--json", action="store_true",
+                   help="one machine-readable summary document on "
+                        "stdout (stage output goes to stderr)")
+    args = p.parse_args(argv)
+    stages = [s.strip() for s in args.stages.split(",") if s.strip()]
+    unknown = set(stages) - set(GATE_STAGES)
+    if unknown:
+        print(f"error: unknown stage(s): {', '.join(sorted(unknown))} "
+              f"(have {', '.join(GATE_STAGES)})", file=sys.stderr)
+        return 2
+
+    def run_stage(name: str) -> int:
+        if name == "lint":
+            return main([])
+        if name == "protocol":
+            from .protocol import main as protocol_main
+            return protocol_main([])
+        if name == "shardflow":
+            from .shardflow import main as shardflow_main
+            return shardflow_main([])
+        from .schedule_check import main as schedule_main
+        return schedule_main([])
+
+    import contextlib
+
+    rcs = {}
+    for name in stages:
+        print(f"=== gate stage: {name} ===",
+              file=sys.stderr if args.json else sys.stdout)
+        try:
+            if args.json:
+                with contextlib.redirect_stdout(sys.stderr):
+                    rcs[name] = run_stage(name)
+            else:
+                rcs[name] = run_stage(name)
+        except SystemExit as e:  # stage argparse bail-outs
+            rcs[name] = int(e.code or 0)
+        except Exception as e:
+            print(f"gate stage {name} crashed: {e!r}", file=sys.stderr)
+            rcs[name] = 2
+    worst = max(rcs.values(), default=0)
+    if args.json:
+        print(json.dumps({"schema": "chainermn_tpu.analysis_gate.v1",
+                          "stages": rcs, "exit": worst}, indent=2,
+                         sort_keys=True))
+    else:
+        tally = ", ".join(f"{k}={v}" for k, v in rcs.items())
+        print(f"analysis-gate: {tally} -> exit {worst}",
+              file=sys.stderr)
+    return worst
+
+
 def main(argv: Optional[List[str]] = None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    if "--gate" in argv:
+        rest = [a for a in argv if a != "--gate"]
+        return gate_main(rest)
     args = build_parser().parse_args(argv)
 
     if args.list_rules:

@@ -50,7 +50,7 @@ ShardSpec = Union[None, int]
 
 __all__ = [
     "ShardSpec", "reshard", "make_reshard", "reshard_host", "reshard_cost",
-    "partition_spec_of", "validate_spec",
+    "partition_spec_of", "validate_spec", "lower_schedule",
 ]
 
 
@@ -280,8 +280,70 @@ def _split_even(n: int, parts: int, what: str) -> int:
     return n // parts
 
 
+def lower_schedule(shape, dtype, src_spec, dst_spec, src_world: int,
+                   dst_world: int, kind: str = "auto", topology=None,
+                   n_chunks: int = 2, depth: int = 2):
+    """Lower one (src,dst) spec pair to a VERIFIED collective schedule
+    (ISSUE 19 / ROADMAP item 3).
+
+    ``kind`` names a generator (``single`` — the monolithic lowering
+    :func:`reshard` performs today — ``chunked``, ``pipelined``,
+    ``hierarchical``) or ``"auto"`` to pick the cheapest verified
+    candidate under the r04 cost model.  Every returned schedule has
+    passed the full :mod:`~chainermn_tpu.analysis.schedule_check`
+    verifier (coverage vs the array_split statics, exhaustive BFS of
+    the start/done machine, interpreter byte-exactness) — an
+    unverifiable schedule raises instead of escaping.
+    """
+    from ..analysis.schedule_check import verified_schedule
+
+    return verified_schedule(kind, shape, dtype, src_spec, dst_spec,
+                             src_world, dst_world, topology,
+                             n_chunks=n_chunks, depth=depth)
+
+
+def _scheduled_leaf(vals, src_axis: int, dst_spec, dst_count: int,
+                    kind: str, topology):
+    """Route one sharded leaf through a verified schedule's interpreter.
+
+    Returns the per-destination blocks, or ``None`` when the leaf falls
+    outside the schedule geometry (unequal source blocks, uneven
+    destination split, mixed dtypes) — the caller then takes the direct
+    concatenate/slice path, which is byte-identical by the verifier's
+    own oracle.
+    """
+    import numpy as np
+
+    from ..analysis.schedule import block_shape
+    from ..analysis.schedule_check import run_schedule
+
+    arrs = [np.asarray(v) for v in vals]
+    first = arrs[0]
+    if any(a.shape != first.shape or a.dtype != first.dtype
+           for a in arrs[1:]):
+        return None
+    if not 0 <= src_axis < first.ndim:
+        return None
+    shape = list(first.shape)
+    shape[src_axis] = shape[src_axis] * len(arrs)
+    shape = tuple(shape)
+    if isinstance(dst_spec, int):
+        if not 0 <= dst_spec < first.ndim:
+            return None
+        if shape[dst_spec] % dst_count:
+            return None                  # direct path raises the error
+    sched = lower_schedule(shape, str(first.dtype), src_axis, dst_spec,
+                           len(arrs), dst_count, kind=kind,
+                           topology=topology)
+    outs = run_schedule(sched, [np.ascontiguousarray(a).reshape(-1)
+                                for a in arrs])
+    return [outs[r].reshape(block_shape(shape, dst_spec, r, dst_count))
+            for r in range(dst_count)]
+
+
 def reshard_host(shards: Sequence[Any], src_layout, dst_layout,
-                 dst_count: int) -> List[Any]:
+                 dst_count: int, *, schedule: Optional[str] = None,
+                 topology=None) -> List[Any]:
     """Re-partition per-process host pytrees between world sizes.
 
     ``shards`` is the COMPLETE old-world list (one pytree per source
@@ -298,6 +360,20 @@ def reshard_host(shards: Sequence[Any], src_layout, dst_layout,
     value bit-for-bit on every destination; for sharded leaves the
     concatenation of destination blocks equals the concatenation of
     source blocks (numpy arrays throughout; nothing touches a device).
+
+    ``schedule`` (ISSUE 19) routes sharded-source array leaves through
+    a VERIFIED collective schedule instead of the direct
+    concatenate/slice: ``"auto"`` picks the cheapest candidate under
+    the r04 cost model, or name a generator (``"single"``,
+    ``"chunked"``, ``"pipelined"``, ``"hierarchical"`` — the latter
+    staging cross-slice bytes over a gateway when ``topology`` has a
+    DCN tier).  Every schedule has passed
+    :func:`~chainermn_tpu.analysis.schedule_check.verify_schedule`
+    (coverage reconciled against the same split statics, exhaustive
+    BFS of its start/done machine, interpreter byte-exactness), so the
+    result is bit-identical to the direct path; leaves outside the
+    schedule geometry (replicated/``per_rank`` sources, unequal blocks)
+    keep the direct path.
     """
     import numpy as np
 
@@ -349,6 +425,13 @@ def reshard_host(shards: Sequence[Any], src_layout, dst_layout,
             full = vals[0]
         else:
             src = validate_spec(src, np.asarray(vals[0]).ndim, "src_layout")
+            if schedule is not None and dst != "per_rank":
+                blocks = _scheduled_leaf(vals, src, dst, dst_count,
+                                         schedule, topology)
+                if blocks is not None:
+                    for r in range(dst_count):
+                        out_leaves[r].append(blocks[r])
+                    continue
             full = np.concatenate([np.asarray(v) for v in vals], axis=src)
         if dst is None:
             for r in range(dst_count):

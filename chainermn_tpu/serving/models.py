@@ -16,6 +16,7 @@ caller built (numpy trees here; nothing in this module imports jax).
 
 from __future__ import annotations
 
+import threading
 from typing import Any, Dict, List, Optional
 
 DEFAULT_MODEL_ID = "default"
@@ -52,41 +53,55 @@ class ModelVariant:
 class ModelRegistry:
     """``model_id`` → newest :class:`ModelVariant`; older generations
     are kept addressable (``get(mid, generation=1)``) so an upgrade can
-    compare old/new on the same pinned request."""
+    compare old/new on the same pinned request.
+
+    Thread-safe: ``rolling_upgrade`` registers generation N+1 from the
+    upgrade thread while router scoring / fleet-build threads resolve
+    variants concurrently — every ``_variants`` touch happens under
+    ``_lock`` (the dict-of-dicts ``setdefault``+insert in
+    :meth:`register` is a two-step write; unguarded it races a
+    same-model ``get``'s ``max(gens)`` mid-insert)."""
 
     def __init__(self):
+        self._lock = threading.Lock()
         self._variants: Dict[str, Dict[int, ModelVariant]] = {}
 
     def register(self, variant: ModelVariant) -> ModelVariant:
-        gens = self._variants.setdefault(variant.model_id, {})
-        if variant.generation in gens:
-            raise ValueError(
-                f"model {variant.model_id!r} generation "
-                f"{variant.generation} already registered — weight "
-                f"generations are immutable once published")
-        gens[variant.generation] = variant
-        return variant
+        with self._lock:
+            gens = self._variants.setdefault(variant.model_id, {})
+            if variant.generation in gens:
+                raise ValueError(
+                    f"model {variant.model_id!r} generation "
+                    f"{variant.generation} already registered — weight "
+                    f"generations are immutable once published")
+            gens[variant.generation] = variant
+            return variant
 
     def get(self, model_id: str,
             generation: Optional[int] = None) -> ModelVariant:
-        gens = self._variants.get(str(model_id))
-        if not gens:
-            raise KeyError(f"unknown model_id {model_id!r}; "
-                           f"registered: {self.ids()}")
-        g = max(gens) if generation is None else int(generation)
-        if g not in gens:
-            raise KeyError(f"model {model_id!r} has no generation {g} "
-                           f"(has {sorted(gens)})")
-        return gens[g]
+        with self._lock:
+            gens = self._variants.get(str(model_id))
+            if not gens:
+                known = sorted(self._variants)
+                raise KeyError(f"unknown model_id {model_id!r}; "
+                               f"registered: {known}")
+            g = max(gens) if generation is None else int(generation)
+            if g not in gens:
+                raise KeyError(f"model {model_id!r} has no generation "
+                               f"{g} (has {sorted(gens)})")
+            return gens[g]
 
     def latest_generation(self, model_id: str) -> int:
         return self.get(model_id).generation
 
     def ids(self) -> List[str]:
-        return sorted(self._variants)
+        with self._lock:
+            return sorted(self._variants)
 
     def __contains__(self, model_id: str) -> bool:
-        return str(model_id) in self._variants
+        with self._lock:
+            return str(model_id) in self._variants
 
     def __len__(self) -> int:
-        return len(self._variants)
+        with self._lock:
+            return len(self._variants)

@@ -1,0 +1,62 @@
+#!/usr/bin/env python
+"""The ONE analysis gate — CI face of ``python -m chainermn_tpu.analysis
+--gate``.
+
+Runs every analysis plane in sequence under the shared exit contract
+(0 clean / 1 findings / 2 unusable, worst stage wins):
+
+* **lint** — SPMD + concurrency lock-discipline lint (AST + jaxpr
+  engines, checked-in baselines);
+* **protocol** — exhaustive BFS over the done-XOR-shed / lease-fence /
+  slot-lifecycle machines;
+* **shardflow** — static sharding/cost model reconciled byte-exact
+  against the runtime comm ledger;
+* **schedules** — the ISSUE 19 collective schedule verifier over every
+  fleet-reachable (src,dst) spec pair.
+
+The analysis package is loaded standalone (no ``chainermn_tpu``
+top-level import); the shardflow and jaxpr stages import jax lazily
+and degrade with exit 2 where no backend exists.
+
+Usage::
+
+    python scripts/check_analysis.py
+    python scripts/check_analysis.py --stages lint,schedules
+    python scripts/check_analysis.py --json
+"""
+
+import importlib.util
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_PKG = os.path.join(_REPO, "chainermn_tpu", "analysis")
+
+# the jaxpr/shardflow stages trace registered entry points, which import
+# the REAL chainermn_tpu package — make sure the repo root resolves it
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def _load_analysis():
+    name = "_check_analysis_pkg"
+    if name in sys.modules:
+        return sys.modules[name]
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(_PKG, "__init__.py"),
+        submodule_search_locations=[_PKG])
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def main(argv=None) -> int:
+    analysis = _load_analysis()
+    import importlib
+    cli = importlib.import_module(analysis.__name__ + ".cli")
+    return cli.gate_main(list(sys.argv[1:] if argv is None else argv))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

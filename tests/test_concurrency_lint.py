@@ -769,6 +769,15 @@ class _ScriptedWorker:
             "trace_id": req["trace_id"], "tokens": [1, 2, 3],
             "finish_reason": "max_tokens"})
 
+    def give_back(self, reason="queue_full"):
+        """The PR 18 shed-back: a LIVE worker refuses the dispatched
+        request (worker-side admission race) over the real wire."""
+        req = self.queue.pop(0)
+        self.outbox.send({
+            "kind": "shed", "worker": self.name, "epoch": self.epoch,
+            "trace_id": req["trace_id"],
+            "payload": {"reason": reason, "retry_after_ms": 1.0}})
+
 
 class TestFleetRouterConformance:
     """Sampled model traces driven through a REAL FleetRouter over the
@@ -932,6 +941,190 @@ class TestFleetRouterConformance:
             assert h.shed_payload["reason"] == "worker_lost"
         finally:
             router.close()
+
+    def test_give_back_redispatches_to_survivor(self):
+        # the PR 18 give-back arm: a LIVE owner sheds the request back
+        # (queue_full) and the supervisor redispatches it WITHOUT any
+        # death — exactly one done, on the survivor
+        assert self._model_outcome([
+            "submit(->w0)", "worker0.give_back",
+            "supervisor.failover(w0->w1)", "worker1.produce_result",
+            "router.deliver_result(w1,att2)"]) == (1, 0)
+        router, workers = self._fleet()
+        try:
+            for w in workers.values():
+                w.beat()
+            router.supervisor_tick()
+            h, owner, surv = self._submit_and_find_owner(router,
+                                                         workers)
+            owner.give_back()
+            router.pump()              # shed msg -> failover redispatch
+            surv.drain_ctl()
+            assert len(surv.queue) == 1   # redispatched, not shed
+            assert h.status != "done"
+            surv.produce_result()
+            router.pump()
+            assert h.status == "done" and h.tokens == [1, 2, 3]
+            with router._lock:
+                assert router._results == 1
+        finally:
+            router.close()
+
+    def test_give_back_with_no_survivor_sheds(self):
+        assert self._model_outcome([
+            "submit(->w0)", "worker1.dies", "supervisor.detect(w1)",
+            "worker0.give_back", "supervisor.shed(w0)"]) == (0, 1)
+        router, workers = self._fleet()
+        try:
+            for w in workers.values():
+                w.beat()
+            router.supervisor_tick()
+            h, owner, surv = self._submit_and_find_owner(router,
+                                                         workers)
+            self._wait_dead(router, [owner], [surv.name])
+            owner.give_back()
+            router.pump()
+            assert h.finish_reason == "shed"
+            assert h.shed_payload is not None
+        finally:
+            router.close()
+
+
+class TestGiveBackTransition:
+    """Model-side regression for the PR 18 give-back arm of
+    done_xor_shed (ISSUE 19 satellite): the pinned trace is the exact
+    path the scenario plane's burst workloads take, and reverting the
+    failover guard to its pre-give-back detected-only form must
+    DISABLE the redispatch step — the request would sit returned-but-
+    unowned forever (a liveness hole BFS terminal checking cannot see,
+    because worker deaths always offer an escape edge; hence this
+    pinned structural regression)."""
+
+    TRACE = ("submit(->w0)", "worker0.give_back",
+             "supervisor.failover(w0->w1)", "worker1.produce_result",
+             "router.deliver_result(w1,att2)")
+
+    def _walk(self, model, trace):
+        by_name = {t.name: t for t in model.transitions}
+        s = model.initial
+        for tname in trace:
+            t = by_name[tname]
+            assert t.guard(s), f"{tname} disabled"
+            s = t.apply(s)
+            assert model.invariant(s) is None
+        return s
+
+    def test_pinned_give_back_trace_reaches_done(self):
+        m = P.make_done_xor_shed_model()
+        s = self._walk(m, self.TRACE)
+        assert (s.done, s.shed) == (1, 0)
+        assert m.terminal_invariant(s) is None
+        assert s.attempts == 2 and not s.returned
+
+    def test_give_back_requires_a_live_owner_with_the_request(self):
+        m = P.make_done_xor_shed_model()
+        by_name = {t.name: t for t in m.transitions}
+        gb = by_name["worker0.give_back"]
+        s = self._walk(m, ("submit(->w0)",))
+        assert gb.guard(s)
+        # after the worker publishes its result there is nothing left
+        # to give back (the shed/result race is modeled away)
+        assert not gb.guard(by_name["worker0.produce_result"].apply(s))
+        # a corpse cannot give back
+        assert not gb.guard(by_name["worker0.dies"].apply(s))
+
+    def test_detected_only_failover_guard_disables_redispatch(self):
+        # the regression: drop the `returned` disjunct (the pre-PR-18
+        # guard) and step 3 of the pinned trace is disabled
+        m = P.make_done_xor_shed_model()
+        old = m.replace(
+            "supervisor.failover(w0->w1)",
+            guard=lambda s: (s.registered and s.done + s.shed == 0
+                             and s.owner == 0 and s.detected[0]
+                             and s.attempts < 2
+                             and not s.detected[1]))
+        by_name = {t.name: t for t in old.transitions}
+        s = self._walk(old, self.TRACE[:2])
+        assert s.returned and s.owner == 0
+        assert not by_name["supervisor.failover(w0->w1)"].guard(s)
+        # ...while the current model takes it (same prefix, same state)
+        assert self._walk(P.make_done_xor_shed_model(),
+                          self.TRACE[:3]).attempts == 2
+
+    def test_space_with_give_back_stays_counterexample_free(self):
+        # give_back enlarges the reachable space (returned states);
+        # the full space must still verify exhaustively
+        r = P.check(P.make_done_xor_shed_model())
+        assert r.ok and r.complete
+        graph = P.reachable_graph(P.make_done_xor_shed_model())
+        assert any(s.returned for s in graph)
+
+
+class TestIssue18PathsLintClean:
+    """ISSUE 19 satellite 1: the PR 15 concurrency lint over the PR 18
+    surface (scenario engine, model registry, fleet rolling-upgrade
+    path) — zero findings, zero suppressions, and the ModelRegistry
+    lock discipline holds up behaviorally."""
+
+    PATHS = ("serving/scenarios.py", "serving/models.py",
+             "serving/fleet.py")
+
+    @pytest.mark.parametrize("rel", PATHS)
+    def test_no_findings_no_suppressions(self, rel):
+        path = os.path.join(PKG, rel)
+        hits = C.analyze_file(path)
+        assert hits == [], [f.render() for f in hits]
+        with open(path) as f:
+            src = f.read()
+        assert "spmd-lint: disable" not in src
+
+    def test_model_registry_register_vs_get_race(self):
+        # the guarded two-step write: concurrent same-model registers
+        # (rolling upgrades) against hot get() readers — every reader
+        # sees a complete variant, exactly one writer wins a duplicate
+        # generation, and the newest-generation answer is monotonic
+        from chainermn_tpu.serving.models import (ModelRegistry,
+                                                  ModelVariant)
+        reg = ModelRegistry()
+        reg.register(ModelVariant("m", {"p": 0}, head_dim=4))
+        stop = threading.Event()
+        errors = []
+
+        def writer():
+            g = 2
+            while not stop.is_set():
+                try:
+                    reg.register(ModelVariant("m", {"p": g},
+                                              head_dim=4,
+                                              generation=g))
+                except ValueError:
+                    pass        # duplicate generation — losers bail
+                g += 1
+
+        def reader():
+            last = 0
+            try:
+                while not stop.is_set():
+                    v = reg.get("m")
+                    assert v.head_dim == 4
+                    assert v.generation >= last
+                    last = v.generation
+                    assert "m" in reg and len(reg) >= 1
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = ([threading.Thread(target=writer)
+                    for _ in range(2)]
+                   + [threading.Thread(target=reader)
+                      for _ in range(4)])
+        for t in threads:
+            t.start()
+        time.sleep(0.3)
+        stop.set()
+        for t in threads:
+            t.join(5)
+        assert not errors, errors
+        assert reg.latest_generation("m") >= 2
 
 
 # ==========================================================================

@@ -193,6 +193,12 @@ def _const_grad_runs(steps=50, lr=1e-3, d=264):
         batch = mn.shard_batch((gfix,), mesh)
         for _ in range(steps):
             params, st, loss = step(params, st, batch)
+            # sync per step: 50 async-enqueued 8-participant programs
+            # deadlock XLA's CPU cross-module rendezvous on a 1-core
+            # host (7 ranks parked at the loss pmean, the 8th's launch
+            # starved by later enqueued work) — bounding the in-flight
+            # queue to one step sidesteps it, values unchanged
+            jax.block_until_ready(loss)
         return params, float(loss), st
 
     return run(None), run("int8"), run("int8", True)
