@@ -1982,6 +1982,139 @@ def bench_collective_schedules():
     return result
 
 
+def bench_schedule_truth():
+    """Schedule execution truth plane (ISSUE 20, docs/PERF.md
+    "Cost-model calibration loop"): every fleet pair's chosen schedule
+    EXECUTES under the ``ScheduleExecProfile``, measured transfer
+    bytes reconcile EXACTLY against the IR's declared wire bytes, a
+    per-link (alpha, bw) calibration is least-squares-fitted from the
+    pooled records, and both the stock r04 constants and the
+    calibrated model re-price every pair against its measured wall.
+
+    Host-only (stdlib + numpy; no device work) — every-backend
+    contract.  Gated keys: ``median_rel_err_stock`` /
+    ``median_rel_err_calibrated`` lower-is-better (the acceptance
+    criterion: calibrated prediction error <= stock on this host);
+    ``wire_exposed_frac`` lower-is-better — the fraction of measured
+    wire time EXPOSED on the critical path, i.e. the gateable face of
+    the overlap fraction (``overlap_frac`` = 1 - exposed, reported
+    alongside); ``profiler_overhead_frac`` lower-is-better (< 3%
+    acceptance bound, measured directly per the PR 17
+    ``journal_overhead_frac`` discipline — differencing adjacent runs
+    cannot resolve 3% under CI load); ``reconcile_violations``
+    lower-is-better (bound: 0 — a byte the profiler saw that the IR
+    did not declare is a bug, not noise).  Per-pair raw walls live
+    under ``raw`` (skipped by the gate: single host timings swing
+    ±40% under CI load; the medians above are the stable faces).
+    """
+    import time as _time
+
+    from chainermn_tpu.analysis import calibrate as C
+    from chainermn_tpu.analysis import schedule as S
+    from chainermn_tpu.analysis import schedule_check as SC
+    from chainermn_tpu.observability import comm as _comm
+
+    # MUCH larger than the verifier's (24,4): per-op walls must
+    # dominate both clock granularity and the ~1us/record profiler
+    # cost for the fit — and the overhead gate — to mean anything
+    # (reshard_host's real payloads are model weights, MiBs+).  The
+    # BFS model check's state space depends on program structure, not
+    # element count, so verification cost stays put.
+    shape, dtype = (1 << 17, 16), "float32"   # 8 MiB array
+    reps = 3
+    result = {"config": f"shape {shape} {dtype}, {reps} reps/pair, "
+                        f"{len(SC.FLEET_PAIRS)} fleet pairs, "
+                        f"least-squares per-link fit"}
+    all_records = []
+    pairs = {}
+    reconcile_violations = 0
+    for name, src, dst, sw, dw in SC.FLEET_PAIRS:
+        topo = SC.fleet_pair_topology(sw, dw)
+        sched, report = SC.compile_verified(
+            shape, dtype, src, dst, sw, dw, topo)
+        _, prof = SC.execute_profiled(sched, reps=reps)
+        for run in prof.runs():
+            reconcile_violations += len(prof.reconcile(run))
+        all_records.extend(prof.records)
+        walls = sorted(prof.wall_us(run) for run in prof.runs())
+        pairs[name] = {
+            "sched": sched, "prof": prof,
+            "measured_wall_us": walls[len(walls) // 2],  # median rep
+        }
+
+    cal = C.fit_calibration(all_records)
+    _comm.set_active_calibration(cal)  # /statusz calibration provider
+    errs_stock, errs_cal, exposed, overlaps = [], [], [], []
+    for name, row in pairs.items():
+        sched, prof = row["sched"], row["prof"]
+        m = row["measured_wall_us"]
+        pred_stock = S.price_schedule(sched)["wall_us"]
+        pred_cal = S.price_schedule(sched, calibration=cal)["wall_us"]
+        re_stock = abs(pred_stock - m) / m if m else 0.0
+        re_cal = abs(pred_cal - m) / m if m else 0.0
+        errs_stock.append(re_stock)
+        errs_cal.append(re_cal)
+        cp = C.schedule_critical_path(prof.records)
+        exposed.append(cp["wire_exposed_frac"])
+        overlaps.append(cp["overlap_frac"])
+        result[name] = {
+            "chosen": sched.kind,
+            "dominant_link": cp["dominant_link"],
+            "dominant_op": cp["dominant_op"],
+            "raw": {
+                "measured_wall_us": round(m, 1),
+                "predicted_stock_us": round(pred_stock, 1),
+                "predicted_calibrated_us": round(pred_cal, 1),
+                "rel_err_stock": round(re_stock, 4),
+                "rel_err_calibrated": round(re_cal, 4),
+                "critical_path_us": round(cp["critical_path_us"], 1),
+                "wire_exposed_frac": round(cp["wire_exposed_frac"], 4),
+                "overlap_frac": round(cp["overlap_frac"], 4),
+            },
+        }
+
+    def med(xs):
+        xs = sorted(xs)
+        return xs[len(xs) // 2] if xs else 0.0
+
+    # profiler overhead measured DIRECTLY (the PR 17 discipline): count
+    # the records one execution of every pair produces, microbench one
+    # on_op (two clock reads + record build, the exact production
+    # path), and divide by the pairs' own measured walls.
+    mb_sched = pairs["rolling_upgrade_fanout"]["sched"]
+    mb_prof = SC.ScheduleExecProfile(mb_sched)
+    mb_op = next(op for r in sorted(mb_sched.programs)
+                 for op in mb_sched.programs[r] if op.kind == "start")
+    mb_reps = 20000
+    t0 = _time.perf_counter()
+    for _ in range(mb_reps):
+        tb = mb_prof.now_ns()
+        mb_prof.on_op(mb_op, 0, tb, mb_prof.now_ns())
+    per_record_s = (_time.perf_counter() - t0) / mb_reps
+    records_one_rep = sum(len(row["prof"].run_records())
+                          for row in pairs.values())
+    window_s = sum(row["measured_wall_us"]
+                   for row in pairs.values()) / 1e6
+    result.update({
+        "reconcile_violations": reconcile_violations,
+        "calibration": {
+            link: {"alpha_us": round(fit["alpha_s"] * 1e6, 3),
+                   "bw_gbps": round(fit["bw"] / 1e9, 4),
+                   "fit_residual": round(fit["residual_rel"], 4),
+                   "n": fit["n"]}
+            for link, fit in sorted(cal["links"].items())},
+        "median_rel_err_stock": round(med(errs_stock), 4),
+        "median_rel_err_calibrated": round(med(errs_cal), 4),
+        "calibration_improves": bool(med(errs_cal) <= med(errs_stock)),
+        "wire_exposed_frac": round(med(exposed), 4),
+        "overlap_frac": round(med(overlaps), 4),
+        "profiler_record_cost_us": round(per_record_s * 1e6, 3),
+        "profiler_overhead_frac": round(
+            (records_one_rep * per_record_s) / max(window_s, 1e-9), 4),
+    })
+    return result
+
+
 def bench_elastic_resume():
     """Elastic/preemption robustness perf (ISSUE 8, docs/ROBUSTNESS.md):
     what fault tolerance actually costs, on the gate.
@@ -3111,6 +3244,7 @@ def main():
         "serving_kv_economy": None,
         "serving_scenarios": None,
         "collective_schedules": None,
+        "schedule_truth": None,
         "train_chaos": None,
         "data_path": None,
         "long_context": None,
@@ -3184,6 +3318,10 @@ def main():
                 "drain_shed"),
             "schedules_hier_speedup": g(result, "collective_schedules",
                                         "hier_speedup"),
+            "truth_rel_err_calibrated": g(result, "schedule_truth",
+                                          "median_rel_err_calibrated"),
+            "truth_overlap_frac": g(result, "schedule_truth",
+                                    "overlap_frac"),
             "train_chaos_detection_ms": g(result, "train_chaos",
                                           "detection_ms"),
             "train_chaos_reconfig_ms": g(result, "train_chaos",
@@ -3436,6 +3574,29 @@ def main():
             emit()
     else:
         print("bench: over budget — collective_schedules section skipped",
+              file=sys.stderr)
+
+    # --- schedule truth plane: measured vs predicted (ISSUE 20) ------------
+    # Every-backend contract (pure host execution under the
+    # ScheduleExecProfile).  Gated keys: median_rel_err_stock /
+    # median_rel_err_calibrated / wire_exposed_frac /
+    # profiler_overhead_frac / reconcile_violations all lower-is-better
+    # (wire_exposed_frac is the documented gateable face of the overlap
+    # fraction: overlap_frac = 1 - exposed, so it gates
+    # higher-is-better by construction); acceptance bounds are
+    # reconcile_violations == 0 (measured bytes == IR-declared bytes
+    # per link, exact), median_rel_err_calibrated <=
+    # median_rel_err_stock, and profiler_overhead_frac < 0.03.
+    if not over_budget():
+        try:
+            result["schedule_truth"] = bench_schedule_truth()
+            emit("schedule_truth")
+        except Exception as e:
+            print(f"bench: schedule_truth section failed: {e!r}",
+                  file=sys.stderr)
+            emit()
+    else:
+        print("bench: over budget — schedule_truth section skipped",
               file=sys.stderr)
 
     # --- train chaos: rank death -> live shrink cost (ISSUE 13) ------------

@@ -65,35 +65,51 @@ from .shardflow import (  # noqa: F401  (stdlib-only at import time)
 from .concurrency import CONCURRENCY_RULES  # noqa: F401  (stdlib-only)
 from .protocol import ALL_MODELS as PROTOCOL_MODELS  # noqa: F401
 from .schedule import (  # noqa: F401  (stdlib+numpy only)
+    CALIBRATION_SCHEMA,
     GENERATORS as SCHEDULE_GENERATORS,
     Schedule,
     Topology,
 )
 from .schedule_check import (  # noqa: F401
     FLEET_PAIRS,
+    SCHEDULE_EXEC_SCHEMA,
     SEEDED_FAULTS,
+    ScheduleExecProfile,
     verify_schedule,
+)
+from .calibrate import (  # noqa: F401  (stdlib+numpy only)
+    drift_report,
+    fit_calibration,
+    load_calibration,
+    schedule_critical_path,
 )
 
 __all__ = [
     "AST_RULES",
     "Baseline",
+    "CALIBRATION_SCHEMA",
     "CONCURRENCY_RULES",
     "CollectiveRegistry",
     "FLEET_PAIRS",
     "Finding",
     "PROTOCOL_MODELS",
+    "SCHEDULE_EXEC_SCHEMA",
     "SCHEDULE_GENERATORS",
     "SEEDED_FAULTS",
     "SEVERITIES",
     "SHARDFLOW_RULES",
     "Schedule",
+    "ScheduleExecProfile",
     "ShardflowReport",
     "Topology",
     "analyze_file",
     "analyze_paths",
     "analyze_source",
     "default_registry",
+    "drift_report",
+    "fit_calibration",
     "load_baseline",
+    "load_calibration",
+    "schedule_critical_path",
     "verify_schedule",
 ]

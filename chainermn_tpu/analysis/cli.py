@@ -89,16 +89,20 @@ def _package_dir() -> str:
 
 
 #: the ``--gate`` stages, in run order: each is (name, thunk returning
-#: an exit code under the same 0/1/2 contract).
-GATE_STAGES = ("lint", "protocol", "shardflow", "schedules")
+#: an exit code under the same 0/1/2 contract).  ``calibration``
+#: (ISSUE 20) drift-checks the measured cost-model fit against fresh
+#: schedule_exec records and exits 0 ("skipped") until any exist.
+GATE_STAGES = ("lint", "protocol", "shardflow", "schedules",
+               "calibration")
 
 
 def gate_main(argv: Optional[List[str]] = None) -> int:
     """``python -m chainermn_tpu.analysis --gate`` — ONE CI-callable
     check running every analysis plane: the SPMD+concurrency lint, the
-    protocol model checker, the shardflow statics reconciliation, and
-    the collective schedule verifier.  Exit is the worst stage under
-    the shared contract: 0 clean, 1 findings/violations, 2 unusable.
+    protocol model checker, the shardflow statics reconciliation, the
+    collective schedule verifier, and the cost-model calibration drift
+    check.  Exit is the worst stage under the shared contract: 0
+    clean, 1 findings/violations, 2 unusable.
     """
     p = argparse.ArgumentParser(
         prog="python -m chainermn_tpu.analysis --gate",
@@ -126,6 +130,9 @@ def gate_main(argv: Optional[List[str]] = None) -> int:
         if name == "shardflow":
             from .shardflow import main as shardflow_main
             return shardflow_main([])
+        if name == "calibration":
+            from .calibrate import main as calibrate_main
+            return calibrate_main(["--gate"])
         from .schedule_check import main as schedule_main
         return schedule_main([])
 

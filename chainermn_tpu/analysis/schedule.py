@@ -55,8 +55,10 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 __all__ = [
-    "SCHEDULE_SCHEMA", "Topology", "Chunk", "Transfer", "Op", "Schedule",
-    "CostModel", "block_shape", "block_global_indices", "expected_flow",
+    "SCHEDULE_SCHEMA", "CALIBRATION_SCHEMA", "Topology", "Chunk",
+    "Transfer", "Op", "Schedule",
+    "CostModel", "calibrated_cost_model",
+    "block_shape", "block_global_indices", "expected_flow",
     "lower_single", "lower_chunked", "lower_pipelined",
     "lower_hierarchical", "GENERATORS", "candidate_schedules",
     "price_schedule",
@@ -655,8 +657,51 @@ class CostModel:
         return self.alpha_ici_s if link == "ici" else self.alpha_dcn_s
 
 
+#: Versioned schema of the persisted calibration artifact produced by
+#: :mod:`.calibrate` — per-link (alpha, bw) fitted from measured
+#: ``schedule_exec`` records.  Lives here (not in calibrate.py) so
+#: :func:`price_schedule` can validate it without a circular import.
+CALIBRATION_SCHEMA = "chainermn_tpu.calibration.v1"
+
+
+def calibrated_cost_model(calibration: Optional[dict],
+                          base: Optional[CostModel] = None) -> CostModel:
+    """A :class:`CostModel` with the fitted per-link constants from a
+    calibration artifact substituted over ``base`` (stock r04 constants
+    for any link the fit could not resolve).  Refuses an artifact whose
+    schema version is not ours — a stale calibration silently priced as
+    current is exactly the rot this plane exists to prevent."""
+    cm = base or CostModel()
+    if not calibration:
+        return cm
+    schema = calibration.get("schema")
+    if schema != CALIBRATION_SCHEMA:
+        raise ValueError(
+            f"stale/foreign calibration artifact: schema={schema!r}, "
+            f"want {CALIBRATION_SCHEMA} (re-fit with "
+            f"chainermn_tpu.analysis.calibrate)")
+    links = calibration.get("links") or {}
+    kw: Dict[str, float] = {}
+    ici = links.get("ici") or {}
+    if ici.get("bw"):
+        kw["ici_bw"] = float(ici["bw"])
+        kw["alpha_ici_s"] = float(ici.get("alpha_s", cm.alpha_ici_s))
+    dcn = links.get("dcn") or {}
+    if dcn.get("bw"):
+        kw["dcn_bw"] = float(dcn["bw"])
+        kw["alpha_dcn_s"] = float(dcn.get("alpha_s", cm.alpha_dcn_s))
+    copy = links.get("copy") or {}
+    if copy.get("bw"):
+        kw["copy_bw"] = float(copy["bw"])
+    if not kw:
+        return cm
+    from dataclasses import replace
+    return replace(cm, **kw)
+
+
 def price_schedule(sched: Schedule,
-                   cost_model: Optional[CostModel] = None
+                   cost_model: Optional[CostModel] = None,
+                   calibration: Optional[dict] = None
                    ) -> Dict[str, object]:
     """Deterministic event simulation of one schedule.
 
@@ -668,8 +713,14 @@ def price_schedule(sched: Schedule,
     overlap freely.  ``start`` is asynchronous (the issuing rank does
     not wait); ``done`` blocks until the wire completes; landings and
     local copies cost bytes/copy_bw on the executing rank.
+
+    ``calibration`` is a loaded ``chainermn_tpu.calibration.v1``
+    artifact (see :mod:`.calibrate`): its fitted per-link constants are
+    substituted over ``cost_model`` so candidates rank by MEASURED
+    costs; a stale-schema artifact raises.
     """
-    cm = cost_model or CostModel()
+    cm = calibrated_cost_model(calibration, cost_model) \
+        if calibration is not None else (cost_model or CostModel())
     item = sched.itemsize
     rank_time = {r: 0.0 for r in sched.programs}
     egress: Dict[Tuple[int, str], float] = {}

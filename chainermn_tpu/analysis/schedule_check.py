@@ -42,8 +42,10 @@ from __future__ import annotations
 
 import argparse
 import copy
+import itertools
 import json
 import sys
+import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -61,6 +63,7 @@ __all__ = [
     "make_schedule_model", "run_schedule", "make_input_blocks",
     "expected_output_blocks", "verify_schedule", "seed_fault",
     "SEEDED_FAULTS", "compile_verified", "verified_schedule",
+    "SCHEDULE_EXEC_SCHEMA", "ScheduleExecProfile", "execute_profiled",
     "FLEET_PAIRS",
     "fleet_pair_topology", "main",
 ]
@@ -384,13 +387,179 @@ def expected_output_blocks(sched: Schedule,
             for d in range(sched.dst_world)]
 
 
-def run_schedule(sched: Schedule, in_blocks: Sequence[np.ndarray]
+#: Schema of one measured schedule-execution op record (ISSUE 20).
+#: Fingerprint-keyed so records from many runs of many schedules can be
+#: pooled and still attributed; ``run`` disambiguates executions of the
+#: SAME schedule (the critical-path extractor must not mix two runs).
+SCHEDULE_EXEC_SCHEMA = "chainermn_tpu.schedule_exec.v1"
+
+#: Per-process execution counter feeding ``run`` ids — deliberately NOT
+#: wall-clock-derived, so a replayed fit is deterministic.
+_EXEC_SEQ = itertools.count()
+
+
+class ScheduleExecProfile:
+    """Measured per-op records for executions of ONE schedule.
+
+    :func:`run_schedule` calls :meth:`on_op` around every executed op;
+    each record carries (op, arg, rank, link, bytes, wall_us, t_us)
+    under ``SCHEDULE_EXEC_SCHEMA``, keyed by the schedule fingerprint
+    and a per-execution ``run`` id.  ``link`` is the transfer's wire
+    class for ``start``/``done`` and ``"copy"`` for local
+    ``copy``/``unstage`` ops (they never touch a wire but DO consume
+    the copy engine the cost model prices via ``copy_bw``).
+
+    The profile is the truth side of the calibration loop: byte
+    reconciliation against the IR's declared :meth:`Schedule.wire_bytes`
+    is exact (a measured byte that the IR does not declare — or vice
+    versa — is a profiler bug, not noise), while walls feed the
+    least-squares (alpha, bw) fit in :mod:`.calibrate`.
+    """
+
+    def __init__(self, sched: Schedule, clock_ns=None):
+        self.sched = sched
+        self.schedule = sched.name
+        self.kind = sched.kind
+        self.fingerprint = sched.fingerprint()
+        self.records: List[dict] = []
+        self._clock = clock_ns or time.perf_counter_ns
+        self._item = sched.itemsize
+        self._t0: Optional[int] = None
+        self._run_seq = None  # assigned lazily per begin()
+        # (kind, arg) -> (link, bytes), precomputed so on_op stays a
+        # single dict lookup — this runs inside reshard_host's
+        # schedule interpreter and its cost is the profiler_overhead
+        # the schedule_truth bench gates < 3%.
+        self._info: Dict[Tuple[str, str], Tuple[str, int]] = {}
+        for tid, t in sched.transfers.items():
+            nb = sched.chunks[t.chunk].nelems * self._item
+            self._info[("start", tid)] = (t.link, nb)
+            self._info[("done", tid)] = (t.link, nb)
+        for cname, c in sched.chunks.items():
+            nb = c.nelems * self._item
+            self._info[("copy", cname)] = ("copy", nb)
+            self._info[("unstage", cname)] = ("copy", nb)
+
+    def now_ns(self) -> int:
+        return self._clock()
+
+    def begin(self) -> None:
+        """Mark the start of one execution (a new ``run`` id); called
+        automatically by :func:`run_schedule` so repeated executions
+        through one profile stay distinguishable."""
+        self._run_seq = f"{self.fingerprint}-{next(_EXEC_SEQ)}"
+        self._t0 = None
+
+    def on_op(self, op: Op, rank: int, t_beg_ns: int,
+              t_end_ns: int) -> None:
+        if self._run_seq is None:
+            self.begin()
+        if self._t0 is None:
+            self._t0 = t_beg_ns
+        link, nbytes = self._info[(op.kind, op.arg)]
+        self.records.append({
+            "schema": SCHEDULE_EXEC_SCHEMA,
+            "fingerprint": self.fingerprint,
+            "schedule": self.schedule,
+            "sched_kind": self.kind,
+            "run": self._run_seq,
+            "seq": len(self.records),
+            "op": op.kind,
+            "arg": op.arg,
+            "rank": int(rank),
+            "link": link,
+            "bytes": int(nbytes),
+            "t_us": (t_beg_ns - self._t0) / 1e3,
+            "wall_us": (t_end_ns - t_beg_ns) / 1e3,
+        })
+
+    # -- aggregation faces ----------------------------------------------
+
+    def runs(self) -> List[str]:
+        out: List[str] = []
+        for rec in self.records:
+            if not out or out[-1] != rec["run"]:
+                out.append(rec["run"])
+        return out
+
+    def run_records(self, run: Optional[str] = None) -> List[dict]:
+        runs = self.runs()
+        if not runs:
+            return []
+        run = run or runs[-1]
+        return [r for r in self.records if r["run"] == run]
+
+    def wall_us(self, run: Optional[str] = None) -> float:
+        recs = self.run_records(run)
+        return max((r["t_us"] + r["wall_us"] for r in recs),
+                   default=0.0)
+
+    def measured_wire_bytes(self, run: Optional[str] = None
+                            ) -> Dict[str, int]:
+        """Bytes that crossed each wire in one run — summed over
+        ``start`` records only (a transfer crosses its link once; its
+        ``done`` is the landing copy)."""
+        out = {"ici": 0, "dcn": 0}
+        for r in self.run_records(run):
+            if r["op"] == "start" and r["link"] in out:
+                out[r["link"]] += r["bytes"]
+        return out
+
+    def reconcile(self, run: Optional[str] = None) -> List[str]:
+        """Exact byte reconciliation of one run against the IR: summed
+        measured transfer bytes must EQUAL the schedule's declared
+        :meth:`Schedule.wire_bytes` per link, and every started
+        transfer must have exactly one measured ``done``."""
+        v: List[str] = []
+        declared = self.sched.wire_bytes()
+        measured = self.measured_wire_bytes(run)
+        for link in sorted(declared):
+            if measured.get(link, 0) != declared[link]:
+                v.append(
+                    f"reconcile: {link} measured {measured.get(link, 0)}"
+                    f" B != declared {declared[link]} B")
+        starts: Dict[str, int] = {}
+        dones: Dict[str, int] = {}
+        for r in self.run_records(run):
+            if r["op"] == "start":
+                starts[r["arg"]] = starts.get(r["arg"], 0) + 1
+            elif r["op"] == "done":
+                dones[r["arg"]] = dones.get(r["arg"], 0) + 1
+        if starts != dones:
+            odd = {t for t in set(starts) | set(dones)
+                   if starts.get(t, 0) != dones.get(t, 0)}
+            v.append(f"reconcile: start/done counts differ for "
+                     f"{sorted(odd)}")
+        return v
+
+
+def execute_profiled(sched: Schedule,
+                     in_blocks: Optional[Sequence[np.ndarray]] = None,
+                     reps: int = 1
+                     ) -> Tuple[List[np.ndarray], ScheduleExecProfile]:
+    """Run a verified schedule ``reps`` times under a fresh profiler
+    and return (last outputs, profile) — the bench/`--measure` face."""
+    prof = ScheduleExecProfile(sched)
+    ins = in_blocks if in_blocks is not None else make_input_blocks(sched)
+    outs: List[np.ndarray] = []
+    for _ in range(max(1, int(reps))):
+        outs = run_schedule(sched, ins, profiler=prof)
+    return outs, prof
+
+
+def run_schedule(sched: Schedule, in_blocks: Sequence[np.ndarray],
+                 profiler: Optional[ScheduleExecProfile] = None
                  ) -> List[np.ndarray]:
     """Execute a VERIFIED schedule on host buffers.  Deterministic
     round-robin over ranks; each rank runs its program in order, a
     ``done`` blocking until the matching ``start`` has produced the
     payload.  Byte-exactness vs the direct path is part of
-    :func:`verify_schedule`, so callers may swap schedules freely."""
+    :func:`verify_schedule`, so callers may swap schedules freely.
+
+    With a ``profiler`` every op is timed and recorded
+    (``SCHEDULE_EXEC_SCHEMA``); without one the only added cost is a
+    predicted-taken branch per op — the zero-overhead-off discipline
+    the PR 17 journal set."""
     if len(in_blocks) != sched.src_world:
         raise ValueError(f"need {sched.src_world} in-blocks, got "
                          f"{len(in_blocks)}")
@@ -402,6 +571,8 @@ def run_schedule(sched: Schedule, in_blocks: Sequence[np.ndarray]
     stage: Dict[Tuple[int, str], np.ndarray] = {}
     wire: Dict[str, np.ndarray] = {}
     pcs = {r: 0 for r in sched.programs}
+    if profiler is not None:
+        profiler.begin()
 
     def gather(c: Chunk, src_buf: np.ndarray) -> np.ndarray:
         return np.concatenate([src_buf[so:so + n]
@@ -435,6 +606,7 @@ def run_schedule(sched: Schedule, in_blocks: Sequence[np.ndarray]
                 op = prog[pcs[r]]
                 pcs[r] += 1
                 progressed = True
+                t_beg = profiler.now_ns() if profiler is not None else 0
                 if op.kind == "copy":
                     c = sched.chunks[op.arg]
                     scatter(c, gather(c, ins[r]), outs[r])
@@ -459,6 +631,8 @@ def run_schedule(sched: Schedule, in_blocks: Sequence[np.ndarray]
                 else:
                     raise NotImplementedError(
                         f"interpreter: op kind {op.kind!r} reserved")
+                if profiler is not None:
+                    profiler.on_op(op, r, t_beg, profiler.now_ns())
     stuck = {r: sched.programs[r][pcs[r]].render()
              for r in pcs if pcs[r] < len(sched.programs[r])}
     if stuck:
@@ -695,20 +869,38 @@ SEEDED_FAULTS = ("dropped_chunk", "double_write", "send_recv_cycle",
 _COMPILE_CACHE: Dict[tuple, Tuple[Schedule, dict]] = {}
 
 
+def _calibration_key(calibration: Optional[dict]) -> Optional[str]:
+    """Stable identity of a calibration artifact for the compile cache
+    (two fits with identical constants share an entry; a re-fit with
+    new measurements invalidates)."""
+    if not calibration:
+        return None
+    import hashlib
+    blob = json.dumps(calibration, sort_keys=True,
+                      separators=(",", ":"), default=str).encode()
+    return hashlib.sha256(blob).hexdigest()[:16]
+
+
 def compile_verified(shape, dtype, src_spec, dst_spec, src_world,
                      dst_world, topology: Optional[Topology] = None,
                      n_chunks: int = 2, depth: int = 2,
                      cost_model: Optional[CostModel] = None,
+                     calibration: Optional[dict] = None,
                      max_states: int = 500_000
                      ) -> Tuple[Schedule, dict]:
     """Generate candidates, verify every one, and return the cheapest
     VERIFIED schedule plus its price row (with the baseline cost and
     per-candidate table attached).  Results are cached per geometry —
-    the ``make_reshard``-style compile-once contract."""
+    the ``make_reshard``-style compile-once contract.
+
+    With ``calibration`` (a loaded ``chainermn_tpu.calibration.v1``
+    artifact) candidates rank by MEASURED per-link constants instead of
+    the stock r04 assumptions; the calibration's identity participates
+    in the cache key so a re-fit re-ranks."""
     key = (tuple(shape), str(dtype), src_spec, dst_spec,
            int(src_world), int(dst_world),
            (topology.slices, topology.per_slice) if topology else None,
-           int(n_chunks), int(depth))
+           int(n_chunks), int(depth), _calibration_key(calibration))
     hit = _COMPILE_CACHE.get(key)
     if hit is not None:
         return hit
@@ -723,7 +915,7 @@ def compile_verified(shape, dtype, src_spec, dst_spec, src_world,
             raise RuntimeError(
                 f"generator emitted an unverifiable schedule:\n"
                 f"{vr.render()}")
-        row = price_schedule(sc, cost_model)
+        row = price_schedule(sc, cost_model, calibration=calibration)
         row["n_states"] = vr.n_states
         rows.append(row)
         if best is None or row["cost_ms"] < best[1]["cost_ms"]:

@@ -405,3 +405,95 @@ def accounted_method(op: str):
 
 #: Back-compat alias (the helper predates its public face).
 _payload_info = payload_info
+
+
+# --------------------------------------------------------------------------
+# schedule-execution counters + the /statusz calibration provider
+# (ISSUE 20: the collective truth plane's always-on aggregate face)
+# --------------------------------------------------------------------------
+
+_SCHED_LOCK = threading.Lock()
+_SCHED_EXEC: Dict[str, float] = {}
+_ACTIVE_CALIBRATION: Optional[Dict[str, Any]] = None
+_CAL_PROVIDER_REGISTERED = False
+
+
+def _register_calibration_provider() -> None:
+    global _CAL_PROVIDER_REGISTERED
+    if _CAL_PROVIDER_REGISTERED:
+        return
+    from . import flight as _flight
+    _flight.register_provider("calibration", calibration_snapshot)
+    _CAL_PROVIDER_REGISTERED = True
+
+
+def record_schedule_exec(records) -> None:
+    """Book one profiled schedule execution's records into the
+    ``schedule_exec/*`` counters (/metricsz face) and tracer counters
+    (Chrome trace face).  Called by ``reshard._emit_schedule_exec``;
+    first booking registers the /statusz ``calibration`` provider."""
+    if not records:
+        return
+    with _SCHED_LOCK:
+        for r in records:
+            link = r.get("link", "?")
+            _SCHED_EXEC[f"schedule_exec/{link}/ops"] = \
+                _SCHED_EXEC.get(f"schedule_exec/{link}/ops", 0.0) + 1
+            _SCHED_EXEC[f"schedule_exec/{link}/bytes"] = \
+                _SCHED_EXEC.get(f"schedule_exec/{link}/bytes", 0.0) \
+                + float(r.get("bytes", 0))
+            _SCHED_EXEC[f"schedule_exec/{link}/wall_us"] = \
+                _SCHED_EXEC.get(f"schedule_exec/{link}/wall_us", 0.0) \
+                + float(r.get("wall_us", 0.0))
+        _SCHED_EXEC["schedule_exec/records"] = \
+            _SCHED_EXEC.get("schedule_exec/records", 0.0) + len(records)
+        _SCHED_EXEC["schedule_exec/executions"] = \
+            _SCHED_EXEC.get("schedule_exec/executions", 0.0) + 1
+    tr = trace.get_tracer()
+    if tr.enabled:
+        tr.add_counter("schedule_exec/records", float(len(records)))
+    _register_calibration_provider()
+
+
+def schedule_exec_gauges() -> Dict[str, float]:
+    """Snapshot of the ``schedule_exec/*`` counters (merged into
+    /metricsz the same way the flight drop counts are)."""
+    with _SCHED_LOCK:
+        return dict(_SCHED_EXEC)
+
+
+def set_active_calibration(cal: Optional[Dict[str, Any]]) -> None:
+    """Install (or clear) the calibration artifact the process is
+    currently pricing schedules with; surfaces via the /statusz
+    ``calibration`` provider."""
+    global _ACTIVE_CALIBRATION
+    with _SCHED_LOCK:
+        _ACTIVE_CALIBRATION = cal
+    if cal is not None:
+        _register_calibration_provider()
+
+
+def calibration_snapshot() -> Dict[str, Any]:
+    """The /statusz ``calibration`` provider: live counters plus the
+    active artifact's fitted constants (if one is installed)."""
+    with _SCHED_LOCK:
+        counters = dict(_SCHED_EXEC)
+        cal = _ACTIVE_CALIBRATION
+    out: Dict[str, Any] = {"counters": counters}
+    if cal is None:
+        out["calibration"] = None
+    else:
+        out["calibration"] = {
+            "schema": cal.get("schema"),
+            "n_records": cal.get("n_records"),
+            "links": cal.get("links"),
+        }
+    return out
+
+
+def reset_schedule_exec() -> None:
+    """Test hook: clear counters and the active calibration."""
+    global _ACTIVE_CALIBRATION
+    with _SCHED_LOCK:
+        _SCHED_EXEC.clear()
+        _ACTIVE_CALIBRATION = None

@@ -101,6 +101,13 @@ class StatusServer:
             extra = dict(extra or {})
             for kind, n in sorted(dropped.items()):
                 extra[f"flight/dropped/{kind}"] = float(n)
+        # schedule-execution truth counters (ISSUE 20): per-link
+        # ops/bytes/wall measured by the reshard profiler
+        from .comm import schedule_exec_gauges
+        sched = schedule_exec_gauges()
+        if sched:
+            extra = dict(extra or {})
+            extra.update(sched)
         return prometheus_text(extra)
 
     def requestz(self) -> Any:

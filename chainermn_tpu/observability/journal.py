@@ -470,6 +470,68 @@ def request_story(merged: Dict[str, Any],
             "failovers": failovers, "remote_pull_events": pulls}
 
 
+def _event_label(e: Dict[str, Any]) -> str:
+    kind = str(e.get("kind"))
+    sub = e.get("event")
+    return f"{kind}:{sub}" if sub else kind
+
+
+def request_critical_path(merged: Dict[str, Any],
+                          trace_id: str) -> Dict[str, Any]:
+    """The longest chain of one served request (ISSUE 20): walk the
+    request's journaled events in happens-before order and attribute
+    the wall between each consecutive pair to a named SEGMENT
+    (``fleet:submitted -> fleet:dispatched`` is queueing,
+    ``fleet:dispatched -> fleet:prefill_done`` is prefill, and so on).
+    A request's events form one causal chain (failover hops included),
+    so the HLC-ordered walk IS the critical path; the dominant segment
+    names where this request's latency actually went."""
+    story = request_story(merged, trace_id)
+    evs = story["events"]
+    segments: List[Dict[str, Any]] = []
+    for a, b in zip(evs, evs[1:]):
+        ha = (a.get("hlc") or [0, 0])[0]
+        hb = (b.get("hlc") or [0, 0])[0]
+        segments.append({
+            "from": _event_label(a), "to": _event_label(b),
+            "src_proc": a.get("proc"), "dst_proc": b.get("proc"),
+            "us": max(0, int(hb) - int(ha)),
+        })
+    total = sum(s["us"] for s in segments)
+    dominant = max(segments, key=lambda s: s["us"]) if segments \
+        else None
+    return {
+        "trace_id": trace_id,
+        "n_events": len(evs),
+        "total_us": total,
+        "segments": segments,
+        "dominant": dominant,
+        "dominant_frac": (dominant["us"] / total)
+        if dominant and total else 0.0,
+        "outcome": story.get("outcome"),
+    }
+
+
+def render_critical_path(cp: Dict[str, Any]) -> str:
+    if not cp.get("segments"):
+        return (f"request {cp.get('trace_id')}: no critical path "
+                f"(fewer than two journaled events)")
+    lines = [f"request {cp['trace_id']}: critical path "
+             f"{cp['total_us']}us over {cp['n_events']} events"]
+    for s in cp["segments"]:
+        mark = " <-- dominant" if s is cp.get("dominant") else ""
+        hop = "" if s["src_proc"] == s["dst_proc"] \
+            else f" [{s['src_proc']} -> {s['dst_proc']}]"
+        lines.append(f"  {s['from']} -> {s['to']}: {s['us']}us"
+                     f"{hop}{mark}")
+    d = cp.get("dominant")
+    if d is not None:
+        lines.append(f"  dominant: {d['from']} -> {d['to']} "
+                     f"({d['us']}us, {cp['dominant_frac']:.0%} of "
+                     f"the path)")
+    return "\n".join(lines)
+
+
 def render_request_story(story: Dict[str, Any]) -> str:
     """Human rendering of :func:`request_story`: one HLC-ordered line
     per event, cross-process edges called out, verdict at the end."""
@@ -526,7 +588,13 @@ def export_perfetto(merged: Dict[str, Any], out_path: str
     :func:`~.aggregate.merge_trace_shards` machinery the trainer's
     trace shards use (pid = lane, metadata names the proc).  Timestamps
     are the HLC physical component (µs), so cross-process causality
-    reads left-to-right on one shared timeline."""
+    reads left-to-right on one shared timeline.
+
+    Schedule-execution records (``kind="schedule_exec"``, ISSUE 20)
+    get their own THREAD lane per process (tid 1) as complete events
+    with their measured wall as the duration — an executed collective
+    schedule is visible in the same doc as the request flow that
+    triggered it."""
     from .aggregate import merge_trace_shards, shard_path
 
     procs = list(merged.get("procs") or [])
@@ -537,10 +605,22 @@ def export_perfetto(merged: Dict[str, Any], out_path: str
         trace_events: List[Dict[str, Any]] = [
             {"ph": "M", "name": "process_name", "pid": rank, "tid": 0,
              "args": {"name": f"journal:{proc}"}}]
+        if any(e.get("kind") == "schedule_exec" for e in evs):
+            trace_events.append(
+                {"ph": "M", "name": "thread_name", "pid": rank,
+                 "tid": 1, "args": {"name": "schedule_exec"}})
         for e in evs:
             hlc = e.get("hlc") or [0, 0]
             args = {k: v for k, v in e.items()
                     if k not in ("schema", "proc", "hlc", "t", "idx")}
+            if e.get("kind") == "schedule_exec":
+                trace_events.append(
+                    {"ph": "X", "pid": rank, "tid": 1,
+                     "name": f"{e.get('op')}({e.get('arg')})",
+                     "ts": int(hlc[0]) + int(hlc[1]),
+                     "dur": max(1, int(float(e.get("wall_us", 1)))),
+                     "cat": "schedule_exec", "args": args})
+                continue
             trace_events.append(
                 {"ph": "i", "name": str(e.get("kind")), "pid": rank,
                  "tid": 0, "s": "t", "ts": int(hlc[0]) + int(hlc[1]),

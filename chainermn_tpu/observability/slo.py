@@ -177,10 +177,14 @@ class GoodputLedger:
     def __init__(self, wall_clock: Callable[[], float] = time.monotonic):
         self._clock = wall_clock
         self._buckets: Dict[str, float] = {b: 0.0 for b in self.BUCKETS}
+        self._wire_s = 0.0
+        self._hidden_s = 0.0
         self._t0 = self._clock()
 
     def reset(self) -> None:
         self._buckets = {b: 0.0 for b in self.BUCKETS}
+        self._wire_s = 0.0
+        self._hidden_s = 0.0
         self._t0 = self._clock()
 
     def add(self, bucket: str, seconds: float) -> None:
@@ -196,6 +200,19 @@ class GoodputLedger:
             yield
         finally:
             self.add(bucket, self._clock() - t0)
+
+    def add_overlap(self, wire_s: float, hidden_s: float) -> None:
+        """Book comm-overlap attribution (ISSUE 20): ``wire_s`` seconds
+        of measured wire time, of which ``hidden_s`` were hidden behind
+        other work (off the critical path — the schedule profiler's
+        ``wire_hidden_us``).  Deliberately NOT a bucket: hidden wire
+        time overlaps compute that is already booked, so adding it to
+        the partition would double-count the wall.  It is a first-class
+        attribution axis ON TOP of the partition — the overlap fraction
+        ROADMAP item 5's async-dispatch refactor is gated on."""
+        wire_s = max(float(wire_s), 0.0)
+        self._wire_s += wire_s
+        self._hidden_s += min(max(float(hidden_s), 0.0), wire_s)
 
     def buckets(self) -> Dict[str, float]:
         return dict(self._buckets)
@@ -213,6 +230,11 @@ class GoodputLedger:
                           for k, v in self._buckets.items()},
             "buckets_frac": {k: round(v / wall, 4)
                              for k, v in self._buckets.items()},
+            "comm_wire_s": round(self._wire_s, 6),
+            "comm_hidden_s": round(self._hidden_s, 6),
+            "comm_exposed_s": round(self._wire_s - self._hidden_s, 6),
+            "overlap_frac": round(self._hidden_s / self._wire_s, 4)
+            if self._wire_s > 0 else 0.0,
         }
         return rep
 
@@ -220,7 +242,8 @@ class GoodputLedger:
         """Prometheus-ready flat gauges (``extra_gauges`` shape)."""
         rep = self.report()
         out = {f"{prefix}/goodput_frac": rep["goodput_frac"],
-               f"{prefix}/coverage_frac": rep["coverage_frac"]}
+               f"{prefix}/coverage_frac": rep["coverage_frac"],
+               f"{prefix}/overlap_frac": rep["overlap_frac"]}
         for k, v in rep["buckets_s"].items():
             out[f"{prefix}/{k}_s"] = v
         return out

@@ -302,6 +302,39 @@ def lower_schedule(shape, dtype, src_spec, dst_spec, src_world: int,
                              n_chunks=n_chunks, depth=depth)
 
 
+def _emit_schedule_exec(prof) -> None:
+    """Fan one profiled execution's records out to the observability
+    plane (ISSUE 20): every op becomes an HLC-stamped journal line
+    (``kind="schedule_exec"``, fingerprint-keyed), a tracer complete
+    event on the live trace, and a ``schedule_exec/*`` counter bump in
+    the comm ledger; one flight-note summary rides the /statusz ring.
+    """
+    from ..observability import comm as _comm
+    from ..observability import journal as _journal
+    from ..observability import trace as _trace
+
+    recs = prof.run_records()
+    if not recs:
+        return
+    if _journal.enabled():
+        for rec in recs:
+            _journal.emit("schedule_exec",
+                          **{k: v for k, v in rec.items()
+                             if k != "schema"})
+    _comm.record_schedule_exec(recs)
+    if _trace.enabled():
+        # the run just finished: back-date each op from "now" so the
+        # lane lines up with the surrounding spans.
+        base = _trace.now_us() - prof.wall_us()
+        for rec in recs:
+            _trace.complete_event(
+                f"sched/{rec['op']}({rec['arg']})",
+                int(base + rec["t_us"]), max(1, int(rec["wall_us"])),
+                cat="schedule_exec", link=rec["link"],
+                rank=rec["rank"], bytes=rec["bytes"],
+                fingerprint=rec["fingerprint"])
+
+
 def _scheduled_leaf(vals, src_axis: int, dst_spec, dst_count: int,
                     kind: str, topology):
     """Route one sharded leaf through a verified schedule's interpreter.
@@ -311,6 +344,12 @@ def _scheduled_leaf(vals, src_axis: int, dst_spec, dst_count: int,
     destination split, mixed dtypes) — the caller then takes the direct
     concatenate/slice path, which is byte-identical by the verifier's
     own oracle.
+
+    When the journal or tracer is live the execution runs under a
+    :class:`~chainermn_tpu.analysis.schedule_check.ScheduleExecProfile`
+    and every op lands in the observability plane (see
+    :func:`_emit_schedule_exec`); with both off, not a single record is
+    built — the PR 17 zero-overhead-off discipline.
     """
     import numpy as np
 
@@ -335,8 +374,16 @@ def _scheduled_leaf(vals, src_axis: int, dst_spec, dst_count: int,
     sched = lower_schedule(shape, str(first.dtype), src_axis, dst_spec,
                            len(arrs), dst_count, kind=kind,
                            topology=topology)
+    profiler = None
+    from ..observability import journal as _journal
+    from ..observability import trace as _trace
+    if _journal.enabled() or _trace.enabled():
+        from ..analysis.schedule_check import ScheduleExecProfile
+        profiler = ScheduleExecProfile(sched)
     outs = run_schedule(sched, [np.ascontiguousarray(a).reshape(-1)
-                                for a in arrs])
+                                for a in arrs], profiler=profiler)
+    if profiler is not None:
+        _emit_schedule_exec(profiler)
     return [outs[r].reshape(block_shape(shape, dst_spec, r, dst_count))
             for r in range(dst_count)]
 

@@ -1,0 +1,191 @@
+#!/usr/bin/env python
+"""Direction-aware trend table over a ``bench_history.jsonl`` trajectory.
+
+``check_perf_regression.py --history`` is the binary gate (newest round
+vs previous, exit 1 on regression); this is the human face of the same
+file — every gated key's FULL trajectory across rounds, annotated with
+the direction that counts as better for that key, so a slow drift that
+never trips the 5% per-round gate is still visible as a monotone column.
+
+Shares the gate's own machinery (``lower_is_better`` / ``_flatten`` /
+``compare``) by importing ``check_perf_regression`` from this directory
+— the table can never disagree with the gate about a key's direction or
+about what regressed.  No JAX import, no framework import.
+
+Per key the table shows the last ``--rounds`` values (oldest → newest),
+the direction (``<`` lower-is-better, ``>`` higher-is-better), the total
+relative change across the shown window SIGNED so positive = worse (the
+``compare`` convention), and a verdict column: ``REGR`` when the
+newest-vs-previous step alone trips ``--threshold`` (exactly the gate's
+criterion), ``drift`` when the step is inside the threshold but the
+window total is outside it (the slow-leak case the gate misses), else
+blank.
+
+Exit codes (the ``check_perf_regression.py`` contract): 0 = newest
+round shows no regression vs the previous one, 1 = regression(s), 2 =
+fewer than two usable rounds / unusable input.
+
+Usage::
+
+    python scripts/bench_trajectory.py bench_history.jsonl
+    python scripts/bench_trajectory.py bench_history.jsonl \
+        --rounds 8 --match schedule_truth --json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import check_perf_regression as gate  # noqa: E402
+
+
+def load_rounds(path: str) -> Dict[int, Dict[str, float]]:
+    """Every usable round of the trajectory, keyed by round number —
+    the all-rounds face of ``check_perf_regression.load_history`` (same
+    record contract: int ``n`` + dict ``parsed``; torn/foreign lines
+    skipped)."""
+    rounds: Dict[int, Dict[str, float]] = {}
+    try:
+        with open(path) as f:
+            lines = f.read().splitlines()
+    except OSError as e:
+        print(f"bench_trajectory: cannot read history {path!r}: {e} "
+              f"(exit 2)", file=sys.stderr)
+        raise SystemExit(2)
+    for line in lines:
+        if not line.strip():
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue  # torn tail from a killed bench run
+        if not (isinstance(rec, dict) and isinstance(rec.get("n"), int)
+                and isinstance(rec.get("parsed"), dict)):
+            continue
+        flat: Dict[str, float] = {}
+        gate._flatten(rec["parsed"], "", flat)
+        if flat:
+            rounds[rec["n"]] = flat  # same n twice: latest wins
+    return rounds
+
+
+def _fmt(v: float) -> str:
+    if v != v:  # NaN guard (should not survive _flatten)
+        return "nan"
+    a = abs(v)
+    if a != 0 and (a >= 1e5 or a < 1e-3):
+        return f"{v:.3g}"
+    return f"{v:g}" if float(v).is_integer() and a < 1e5 else f"{v:.4g}"
+
+
+def trend_rows(rounds: Dict[int, Dict[str, float]], window: int,
+               threshold: float, match: str = "") -> List[dict]:
+    ns = sorted(rounds)[-window:]
+    keys = sorted({k for n in ns for k in rounds[n]})
+    if match:
+        keys = [k for k in keys if match in k]
+    rows: List[dict] = []
+    for k in keys:
+        series = [(n, rounds[n][k]) for n in ns if k in rounds[n]]
+        if len(series) < 2:
+            continue
+        lower = gate.lower_is_better(k)
+        first, prev, cur = series[0][1], series[-2][1], series[-1][1]
+
+        def worse(b: float, c: float) -> float:
+            if abs(b) < 1e-12:
+                return 0.0
+            return (c - b) / abs(b) if lower else (b - c) / abs(b)
+
+        step, total = worse(prev, cur), worse(first, cur)
+        verdict = ""
+        if step > threshold:
+            verdict = "REGR"
+        elif total > threshold:
+            verdict = "drift"
+        rows.append({
+            "key": k,
+            "direction": "lower" if lower else "higher",
+            "rounds": [n for n, _ in series],
+            "values": [v for _, v in series],
+            "step_worse": round(step, 4),
+            "window_worse": round(total, 4),
+            "verdict": verdict,
+        })
+    return rows
+
+
+def render_table(rows: List[dict]) -> str:
+    if not rows:
+        return "(no comparable keys)"
+    width = max(len(r["key"]) for r in rows)
+    out = []
+    for r in rows:
+        arrow = "<" if r["direction"] == "lower" else ">"
+        vals = " -> ".join(_fmt(v) for v in r["values"])
+        tag = f"  [{r['verdict']}]" if r["verdict"] else ""
+        out.append(f"{arrow} {r['key']:<{width}}  {vals}  "
+                   f"(step {r['step_worse'] * 100:+.1f}%, "
+                   f"window {r['window_worse'] * 100:+.1f}%){tag}")
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="direction-aware trend table over "
+                    "bench_history.jsonl; exit 1 when the newest round "
+                    "regressed vs the previous one")
+    parser.add_argument("history", help="bench_history.jsonl path")
+    parser.add_argument("--rounds", type=int, default=5,
+                        help="how many trailing rounds to tabulate "
+                             "(default 5)")
+    parser.add_argument("--threshold", type=float, default=0.05,
+                        help="relative worsening that counts (default "
+                             "0.05 = 5%%, the gate's default)")
+    parser.add_argument("--match", default="",
+                        help="only show keys containing this substring "
+                             "(display filter; the exit code still "
+                             "gates every key)")
+    parser.add_argument("--json", action="store_true",
+                        help="emit rows as one JSON object on stdout")
+    args = parser.parse_args(argv)
+
+    rounds = load_rounds(args.history)
+    if len(rounds) < 2:
+        print(f"bench_trajectory: history {args.history!r} holds "
+              f"{len(rounds)} usable round(s); need 2 (exit 2)",
+              file=sys.stderr)
+        return 2
+    rows = trend_rows(rounds, max(2, args.rounds), args.threshold,
+                      args.match)
+    # the exit code is the GATE's verdict, unaffected by --match
+    gated = rows if not args.match else trend_rows(
+        rounds, max(2, args.rounds), args.threshold)
+    n_regr = sum(1 for r in gated if r["verdict"] == "REGR")
+    if args.json:
+        print(json.dumps({
+            "ok": n_regr == 0,
+            "threshold": args.threshold,
+            "rounds": sorted(rounds)[-max(2, args.rounds):],
+            "n_regressions": n_regr,
+            "keys": rows,
+        }, sort_keys=True))
+    else:
+        ns = sorted(rounds)[-max(2, args.rounds):]
+        print(f"bench_trajectory: rounds {ns[0]}..{ns[-1]} "
+              f"({len(rounds)} total), threshold "
+              f"{args.threshold * 100:.0f}% "
+              f"(< lower-is-better, > higher-is-better)")
+        print(render_table(rows))
+        print(f"bench_trajectory: {n_regr} regression(s) newest vs "
+              f"previous round")
+    return 1 if n_regr else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

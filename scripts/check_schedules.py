@@ -19,7 +19,16 @@ Checks (any failure ⇒ exit 1):
 * **fault_corpus** — the seeded-fault mutators (dropped chunk, double
   write, send/recv cycle, done-before-start, buffer overrun) are each
   caught on a representative schedule — 0 false negatives — while the
-  clean candidates all pass — 0 false positives.
+  clean candidates all pass — 0 false positives;
+* **reconciled** (``--measure`` only) — every chosen schedule EXECUTES
+  under the ``ScheduleExecProfile`` and the measured transfer bytes
+  reconcile exactly against the IR's declared per-link wire bytes
+  (ISSUE 20, docs/PERF.md "Cost-model calibration loop"); the pooled
+  records are least-squares-fitted into a per-link (alpha, bw)
+  calibration, reported per pair as measured wall + stock/calibrated
+  relative error and optionally persisted via ``--calibration-out``
+  for ``price_schedule(calibration=)`` /
+  ``python -m chainermn_tpu.analysis --gate`` drift checking.
 
 Exit codes (the ``check_perf_regression.py`` contract): 0 = all pairs
 verified and checks passed, 1 = a violation or a missed fault, 2 =
@@ -37,6 +46,8 @@ Usage::
     python scripts/check_schedules.py
     python scripts/check_schedules.py --shape 48,8 --chunks 2 --json
     python scripts/check_schedules.py --history-out bench_history.jsonl
+    python scripts/check_schedules.py --measure --calibration-out \
+        calibration.json
 """
 
 from __future__ import annotations
@@ -101,6 +112,16 @@ def main(argv=None) -> int:
     p.add_argument("--skip-fault-corpus", action="store_true",
                    help="skip the seeded-fault self-test (pair "
                         "verification only)")
+    p.add_argument("--measure", action="store_true",
+                   help="execute every chosen schedule under the "
+                        "profiler, reconcile measured bytes against "
+                        "the IR, and fit a per-link calibration")
+    p.add_argument("--reps", type=int, default=3,
+                   help="profiled executions per pair with --measure "
+                        "(default 3; the median wall is reported)")
+    p.add_argument("--calibration-out", default=None,
+                   help="with --measure: persist the fitted "
+                        "calibration artifact to this path")
     p.add_argument("--history-out", default=None,
                    help="append one {n, cmd, rc, t, parsed} record to "
                         "this bench_history.jsonl trajectory")
@@ -118,6 +139,7 @@ def main(argv=None) -> int:
         return 2
 
     pairs = {}
+    chosen_scheds = {}
     violations = []
     hier_speedup = None
     try:
@@ -139,6 +161,7 @@ def main(argv=None) -> int:
                 rows.append(row)
                 if best is None or row["cost_ms"] < best["cost_ms"]:
                     best = row
+                    chosen_scheds[name] = sched
             ok = bool(rows) and len(rows) == len(cands)
             pairs[name] = {
                 "ok": ok,
@@ -179,6 +202,57 @@ def main(argv=None) -> int:
                 else:
                     corpus["caught"] += 1
 
+    measured = None
+    if args.measure:
+        try:
+            CA = importlib.import_module(analysis.__name__
+                                         + ".calibrate")
+            all_records = []
+            reconcile_violations = []
+            for name, sched in chosen_scheds.items():
+                _, prof = SC.execute_profiled(sched,
+                                              reps=max(1, args.reps))
+                for run in prof.runs():
+                    for v in prof.reconcile(run):
+                        reconcile_violations.append(f"{name}: {v}")
+                all_records.extend(prof.records)
+                walls = sorted(prof.wall_us(r) for r in prof.runs())
+                m = walls[len(walls) // 2]
+                stock = SC.price_schedule(sched)["wall_us"]
+                pairs[name]["measured"] = {
+                    "wall_us": round(m, 1),
+                    "predicted_stock_us": round(stock, 1),
+                    "rel_err_stock": (round(abs(stock - m) / m, 4)
+                                      if m else None),
+                }
+            cal = CA.fit_calibration(all_records)
+            for name, sched in chosen_scheds.items():
+                pc = S.price_schedule(sched, calibration=cal)["wall_us"]
+                m = pairs[name]["measured"]["wall_us"]
+                pairs[name]["measured"].update({
+                    "predicted_calibrated_us": round(pc, 1),
+                    "rel_err_calibrated": (round(abs(pc - m) / m, 4)
+                                           if m else None),
+                })
+            measured = {
+                "n_records": len(all_records),
+                "reps": max(1, args.reps),
+                "reconcile_violations": reconcile_violations,
+                "calibration": {
+                    link: {"alpha_us": round(fit["alpha_s"] * 1e6, 3),
+                           "bw_gbps": round(fit["bw"] / 1e9, 4),
+                           "fit_residual": round(fit["residual_rel"],
+                                                 4),
+                           "n": fit["n"]}
+                    for link, fit in sorted(cal["links"].items())},
+            }
+            if args.calibration_out:
+                CA.save_calibration(cal, args.calibration_out)
+                measured["calibration_out"] = args.calibration_out
+        except Exception as e:
+            print(f"check_schedules: unusable: {e!r}", file=sys.stderr)
+            return 2
+
     checks = {
         "verified": not violations and all(r["ok"]
                                            for r in pairs.values()),
@@ -189,6 +263,8 @@ def main(argv=None) -> int:
                              and not corpus["false_positives"]
                              and corpus["checked"] > 0)),
     }
+    if measured is not None:
+        checks["reconciled"] = not measured["reconcile_violations"]
     rc = 0 if all(checks.values()) else 1
 
     verdict = {
@@ -200,6 +276,7 @@ def main(argv=None) -> int:
         "hier_speedup": hier_speedup,
         "schedule_violations": len(violations),
         "fault_corpus": corpus,
+        "measured": measured,
         "pairs": pairs,
     }
     print(json.dumps(verdict, indent=2, sort_keys=True))

@@ -123,6 +123,46 @@ def explain(bundle: dict) -> dict:
                 k: sp.get(k)
                 for k in ("entries", "bytes", "spills", "restores",
                           "crc_refusals", "evictions")}
+    # collective truth plane (ISSUE 20): what the schedule interpreter
+    # measured on the wire (schedule_exec/* counters) and which fitted
+    # cost model the process was pricing schedules with at death
+    cal = providers.get("calibration")
+    if isinstance(cal, dict):
+        counters = cal.get("counters") or {}
+        if counters:
+            out["schedule_exec"] = {
+                "records": counters.get("schedule_exec/records"),
+                "executions": counters.get("schedule_exec/executions"),
+                "links": {
+                    link: {
+                        "ops": counters.get(f"schedule_exec/{link}/ops"),
+                        "bytes": counters.get(
+                            f"schedule_exec/{link}/bytes"),
+                        "wall_us": counters.get(
+                            f"schedule_exec/{link}/wall_us"),
+                    }
+                    for link in ("ici", "dcn", "copy")
+                    if f"schedule_exec/{link}/ops" in counters},
+            }
+        if isinstance(cal.get("calibration"), dict):
+            c = cal["calibration"]
+            out["calibration"] = {
+                "schema": c.get("schema"),
+                "n_records": c.get("n_records"),
+                "links": {
+                    link: {"alpha_us": round(
+                               float(fit.get("alpha_s", 0.0)) * 1e6, 3),
+                           "bw_gbps": round(
+                               float(fit.get("bw", 0.0)) / 1e9, 4),
+                           "fit_residual": (
+                               round(float(fit["residual_rel"]), 4)
+                               if fit.get("residual_rel") is not None
+                               else None),
+                           "n": fit.get("n")}
+                    for link, fit in sorted(
+                        (c.get("links") or {}).items())
+                    if isinstance(fit, dict)},
+            }
     train = providers.get("train")
     if isinstance(train, dict):
         out["train"] = {k: train.get(k)
@@ -483,6 +523,27 @@ def render_text(rep: dict) -> str:
                 f"    {name} ({t.get('priority')}): admitted "
                 f"{t.get('admitted')}, degraded {t.get('degraded')}, "
                 f"shed {json.dumps(t.get('shed') or {})}")
+    if rep.get("schedule_exec"):
+        se = rep["schedule_exec"]
+        per_link = ", ".join(
+            f"{link} {int(d.get('ops') or 0)} ops / "
+            f"{int(d.get('bytes') or 0)} B / "
+            f"{(d.get('wall_us') or 0.0):.0f}us"
+            for link, d in sorted((se.get("links") or {}).items()))
+        lines.append(
+            f"  schedule exec: {int(se.get('records') or 0)} records "
+            f"over {int(se.get('executions') or 0)} execution(s)"
+            + (f" ({per_link})" if per_link else ""))
+    if rep.get("calibration"):
+        c = rep["calibration"]
+        lines.append(
+            f"  calibration in effect: {c.get('schema')} fitted from "
+            f"{c.get('n_records')} record(s)")
+        for link, fit in sorted((c.get("links") or {}).items()):
+            lines.append(
+                f"    {link}: alpha {fit.get('alpha_us')}us, bw "
+                f"{fit.get('bw_gbps')} GB/s (fit residual "
+                f"{fit.get('fit_residual')}, n={fit.get('n')})")
     if rep.get("rank_lost"):
         rl = rep["rank_lost"]
         lines.append(
@@ -560,8 +621,8 @@ def explain_request(path: str, trace_id: str, *,
     submit → dispatch → [pull] → prefill → ticks → done/shed, with any
     failover hop — from a merged HLC journal (ISSUE 17)."""
     from chainermn_tpu.observability.journal import (
-        MERGE_SCHEMA, find_journals, merge_journals, render_request_story,
-        request_story)
+        MERGE_SCHEMA, find_journals, merge_journals, render_critical_path,
+        render_request_story, request_critical_path, request_story)
 
     if os.path.isdir(path):
         if not find_journals(path):
@@ -587,10 +648,16 @@ def explain_request(path: str, trace_id: str, *,
         print(f"explain_bundle: no journaled events for request "
               f"{trace_id!r}", file=sys.stderr)
         return 2
+    cp = request_critical_path(merged, trace_id)
     if as_json:
+        story = dict(story)
+        story["critical_path"] = cp
         print(json.dumps(story, indent=2, sort_keys=True, default=str))
     else:
         print(render_request_story(story))
+        if cp.get("segments"):
+            print()
+            print(render_critical_path(cp))
     return 0
 
 

@@ -222,6 +222,30 @@ def test_goodput_ledger_partitions_wall_time():
     assert g["x/compute_s"] == pytest.approx(3.0)
 
 
+def test_goodput_ledger_overlap_attribution():
+    # ISSUE 20: comm overlap is ATTRIBUTION metadata, not a bucket —
+    # hidden wire time overlaps compute that is already booked, so
+    # adding it to the partition would double-count the wall
+    t = [0.0]
+    led = GoodputLedger(wall_clock=lambda: t[0])
+    with led.measure("compute"):
+        t[0] += 4.0
+    led.add_overlap(wire_s=2.0, hidden_s=1.5)
+    rep = led.report()
+    assert rep["wall_s"] == pytest.approx(4.0)
+    assert rep["attributed_s"] == pytest.approx(4.0)  # partition intact
+    assert rep["comm_wire_s"] == pytest.approx(2.0)
+    assert rep["comm_hidden_s"] == pytest.approx(1.5)
+    assert rep["comm_exposed_s"] == pytest.approx(0.5)
+    assert rep["overlap_frac"] == pytest.approx(0.75)
+    assert led.gauges("x")["x/overlap_frac"] == pytest.approx(0.75)
+    # hidden can never exceed wire (clamped), and no wire -> 0.0
+    led.add_overlap(wire_s=1.0, hidden_s=5.0)
+    assert led.report()["comm_hidden_s"] == pytest.approx(2.5)
+    led.reset()
+    assert led.report()["overlap_frac"] == 0.0
+
+
 def test_slo_burn_fires_only_on_both_windows_and_debounces():
     t = [0.0]
     pages = []

@@ -347,6 +347,68 @@ def test_export_perfetto_one_lane_per_proc(tmp_path):
     assert {"journal:router", "journal:w0"} <= names
 
 
+def test_export_perfetto_schedule_exec_lane(tmp_path):
+    # ISSUE 20: schedule-exec records become DURATION events on their
+    # own thread lane (tid 1), not instants on the journal lane
+    j = jr.Journal(str(tmp_path / "journal.w0.jsonl"), "w0")
+    for i, (op, arg, link, wall) in enumerate([
+            ("copy", "c0_0_0", "copy", 12.5),
+            ("start", "t0_0_0", "ici", 3.0),
+            ("done", "t0_0_0", "ici", 7.2)]):
+        j.emit("schedule_exec", fingerprint="ab" * 8, run="ab" * 8 + "-0",
+               seq=i, op=op, arg=arg, rank=0, link=link, bytes=256,
+               t_us=float(i), wall_us=wall)
+    j.emit("phase", name="reshard")  # a normal instant stays on tid 0
+    j.close()
+    merged = jr.merge_journals(str(tmp_path))
+    out = str(tmp_path / "journal_trace.json")
+    jr.export_perfetto(merged, out)
+    with open(out) as f:
+        doc = json.load(f)
+    lanes = [e for e in doc["traceEvents"]
+             if e.get("ph") == "M" and e.get("name") == "thread_name"
+             and e["args"]["name"] == "schedule_exec"]
+    assert len(lanes) == 1 and lanes[0]["tid"] == 1
+    xs = [e for e in doc["traceEvents"]
+          if e.get("ph") == "X" and e.get("cat") == "schedule_exec"]
+    assert len(xs) == 3
+    assert {e["name"] for e in xs} == {"copy(c0_0_0)", "start(t0_0_0)",
+                                       "done(t0_0_0)"}
+    for e in xs:
+        assert e["tid"] == 1 and e["dur"] >= 1
+        assert e["args"]["link"] in ("ici", "copy")
+        assert e["args"]["fingerprint"] == "ab" * 8
+    # the instant events still land on tid 0
+    inst = [e for e in doc["traceEvents"]
+            if e.get("ph") == "i" and e["args"].get("kind") == "phase"]
+    assert inst and all(e["tid"] == 0 for e in inst)
+
+
+def test_request_critical_path_names_dominant_segment(tmp_path):
+    # ISSUE 20: the per-request critical path walks the story's
+    # happens-before chain and names the segment the latency went to
+    tid = _synthetic_run(tmp_path)
+    merged = jr.merge_journals(str(tmp_path))
+    cp = jr.request_critical_path(merged, tid)
+    assert cp["trace_id"] == tid and cp["n_events"] >= 3
+    assert len(cp["segments"]) == cp["n_events"] - 1
+    assert cp["total_us"] == sum(s["us"] for s in cp["segments"])
+    dom = cp["dominant"]
+    assert dom is not None and dom["us"] == max(s["us"]
+                                                for s in cp["segments"])
+    assert 0.0 < cp["dominant_frac"] <= 1.0
+    assert cp["outcome"] == {"kind": "done", "worker": "w0",
+                             "reason": "eos"}
+    text = jr.render_critical_path(cp)
+    assert "critical path" in text and "<-- dominant" in text
+    # a cross-process hop is annotated on its segment
+    assert "[router -> w0]" in text
+    # unknown request: an empty, render-safe report
+    empty = jr.request_critical_path(merged, "req-nope")
+    assert empty["segments"] == [] and empty["total_us"] == 0
+    assert "no critical path" in jr.render_critical_path(empty)
+
+
 # ---------------------------------------------------------------------------
 # the CLI's exit contract (wired into `pytest -m lint`)
 # ---------------------------------------------------------------------------

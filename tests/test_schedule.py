@@ -388,3 +388,71 @@ class TestGateCLI:
                   hist.read_text().splitlines()]
         assert rec["rc"] == 0
         assert rec["parsed"]["collective_schedules"]["hier_speedup"] > 1
+
+
+# ==========================================================================
+# schedule execution truth plane (ISSUE 20): reshard_host tees
+# schedule_exec records into the journal, counters ride /metricsz, and
+# the calibrate reader recovers the records for the fit
+# ==========================================================================
+
+class TestScheduleTruth:
+    def test_reshard_emits_journal_records_and_counters(self, tmp_path):
+        from chainermn_tpu.analysis import calibrate as CA
+        from chainermn_tpu.observability import comm
+        from chainermn_tpu.observability import journal as jr
+        from chainermn_tpu.observability.introspect import StatusServer
+        from chainermn_tpu.parallel.reshard import reshard_host
+        comm.reset_schedule_exec()
+        jr.reset()
+        try:
+            jr.configure(str(tmp_path), "w0")
+            rng = np.random.RandomState(0)
+            full = rng.randn(*SHAPE).astype(np.float32)
+            shards = [{"w": blk}
+                      for blk in np.array_split(full, 4, axis=0)]
+            out = reshard_host(shards, {"w": 0}, {"w": 0}, 2,
+                               schedule="auto")
+            assert np.array_equal(np.concatenate(
+                [o["w"] for o in out], axis=0), full)
+            events = [e for e in jr.read_journal(jr.get_journal().path)
+                      if e.get("kind") == "schedule_exec"]
+            assert events, "no schedule_exec events journaled"
+            for e in events:
+                assert e["fingerprint"] and e["run"]
+                assert e["link"] in ("ici", "dcn", "copy")
+                assert e["op"] in ("copy", "start", "done", "unstage")
+            # one run id spans the whole execution; starts balance dones
+            assert len({e["run"] for e in events}) == 1
+            assert (sum(1 for e in events if e["op"] == "start")
+                    == sum(1 for e in events if e["op"] == "done"))
+            # the calibrate reader unwraps the journal envelope
+            recs = CA.read_exec_records(str(tmp_path))
+            assert len(recs) == len(events)
+            assert CA.fit_calibration(recs)["links"]
+            # counters ride /metricsz (prometheus text face)
+            gauges = comm.schedule_exec_gauges()
+            assert gauges["schedule_exec/records"] == len(events)
+            assert gauges["schedule_exec/executions"] == 1.0
+            text = StatusServer().metricsz()
+            assert "schedule_exec" in text
+        finally:
+            jr.reset()
+            comm.reset_schedule_exec()
+
+    def test_no_journal_no_profiler_overhead_path(self):
+        # zero-overhead-off: without journal/trace enabled the reshard
+        # path must not construct a profiler at all
+        from chainermn_tpu.observability import journal as jr
+        from chainermn_tpu.observability import trace as tr
+        from chainermn_tpu.parallel.reshard import reshard_host
+        assert not jr.enabled()
+        assert not tr.get_tracer().enabled
+        rng = np.random.RandomState(1)
+        full = rng.randn(*SHAPE).astype(np.float32)
+        shards = [{"w": blk}
+                  for blk in np.array_split(full, 4, axis=0)]
+        out = reshard_host(shards, {"w": 0}, {"w": 0}, 2,
+                           schedule="auto")
+        assert np.array_equal(np.concatenate(
+            [o["w"] for o in out], axis=0), full)
